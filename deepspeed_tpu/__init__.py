@@ -1,0 +1,76 @@
+"""deepspeed_tpu — a TPU-native large-scale training & inference framework
+with the capabilities of DeepSpeed (reference: HabanaAI/DeepSpeed v0.14.4).
+
+Public API mirrors ``deepspeed/__init__.py``: ``initialize()`` (:69),
+``init_inference()`` (:273), ``init_distributed()`` (comm.py:604) — built
+on JAX/XLA: SPMD sharding over a device mesh instead of process groups,
+jitted fused train steps instead of stream-scheduled CUDA kernels.
+"""
+
+from .version import __version__  # noqa: F401
+
+from . import comm  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401
+from .parallel.topology import MeshTopology, TopologyConfig  # noqa: F401
+from .runtime.config import DeepSpeedTPUConfig, load_config  # noqa: F401
+from .runtime.engine import DeepSpeedEngine, TrainState  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               **kwargs):
+    """Build a training engine (reference ``deepspeed.initialize``,
+    __init__.py:69).  Returns ``(engine, optimizer, dataloader, lr_scheduler)``.
+
+    ``model`` follows the models/base.py protocol (``init_params``/``loss``)
+    or is a :class:`~deepspeed_tpu.runtime.pipe.module.PipelineModule`, which
+    selects the pipeline engine (reference engine-selection, __init__.py:166).
+    """
+    config = config if config is not None else config_params
+    if args is not None and config is None:
+        config = getattr(args, "deepspeed_config", None)
+
+    from .runtime.pipe.module import PipelineModule
+    if isinstance(model, PipelineModule):
+        try:
+            from .runtime.pipe.engine import PipelineEngine
+        except ImportError as e:
+            raise NotImplementedError(
+                "pipeline engine not available in this build") from e
+        engine = PipelineEngine(model=model, config=config,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                collate_fn=collate_fn,
+                                params=model_parameters, **kwargs)
+    else:
+        engine = DeepSpeedEngine(model=model, config=config,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler,
+                                 collate_fn=collate_fn,
+                                 params=model_parameters, **kwargs)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_distributed(dist_backend="xla", **kwargs):
+    return comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Build an inference engine (reference ``deepspeed.init_inference``,
+    __init__.py:273).  See inference/ for the ragged continuous-batching
+    (FastGen) engine."""
+    try:
+        from .inference.engine import InferenceEngine
+    except ImportError as e:
+        raise NotImplementedError(
+            "inference engine not available in this build") from e
+    return InferenceEngine(model=model, config=config, **kwargs)

@@ -1,0 +1,111 @@
+"""Accelerator abstraction (reference ``accelerator/abstract_accelerator.py``).
+
+The reference ABC has ~110 methods because CUDA needs manual streams,
+events, pinned buffers and cache management.  Under XLA those concerns
+disappear into the compiler/runtime, so the TPU-native interface keeps the
+portable surface — identity, device counts, memory stats, dtype support,
+RNG, synchronization, backend naming and the four behavior flags the
+runtime consults — and drops the stream/event machinery (the flags tell the
+runtime it may: ``resolves_data_dependency() == True`` means XLA's dataflow
+ordering replaces manual event sync, exactly how the HPU fork uses them,
+see reference ``runtime/zero/partitioned_param_coordinator.py:311``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    _name: str = "abstract"
+    _communication_backend_name: str = "xla"
+
+    # -- behavior flags (reference abstract_accelerator.py:17-31) ---------
+    def is_synchronized_device(self) -> bool:
+        return False
+
+    def use_host_timers(self) -> bool:
+        return True  # XLA: wall-clock with block_until_ready, no device events
+
+    def resolves_data_dependency(self) -> bool:
+        return True  # XLA dataflow ordering
+
+    def handles_memory_backpressure(self) -> bool:
+        return True  # XLA allocator
+
+    # -- identity ---------------------------------------------------------
+    def device_name(self, device_index: int | None = None) -> str:
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def current_device(self) -> Any:
+        ...
+
+    # -- synchronization --------------------------------------------------
+    def synchronize(self, tree: Any = None) -> None:
+        import jax
+        if tree is not None:
+            jax.block_until_ready(tree)
+        else:
+            # effectively a fence: tiny computation round-trip
+            jax.block_until_ready(jax.numpy.zeros(()))
+
+    # -- RNG (functional on TPU: return PRNG keys) ------------------------
+    def default_generator(self, seed: int = 0):
+        import jax
+        return jax.random.key(seed)
+
+    def manual_seed(self, seed: int):
+        return self.default_generator(seed)
+
+    # -- memory -----------------------------------------------------------
+    @abc.abstractmethod
+    def memory_stats(self, device_index: int | None = None) -> Dict[str, int]:
+        ...
+
+    def available_memory(self, device_index: int | None = None) -> int:
+        stats = self.memory_stats(device_index)
+        return stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+
+    def total_memory(self, device_index: int | None = None) -> int:
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def memory_allocated(self, device_index: int | None = None) -> int:
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index: int | None = None) -> int:
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def empty_cache(self) -> None:
+        pass  # XLA manages its own arena
+
+    # -- dtype support ----------------------------------------------------
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def is_triton_supported(self) -> bool:
+        return False
+
+    def supported_dtypes(self) -> List[str]:
+        return ["float32", "bfloat16", "float16", "int8", "float8_e4m3fn", "float8_e5m2"]
+
+    # -- graphs: jit IS the graph machinery on TPU ------------------------
+    def create_graph(self):
+        raise NotImplementedError("use jax.jit; XLA compilation replaces graph capture")
+
+    # -- op builder dispatch ---------------------------------------------
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops"

@@ -1,0 +1,33 @@
+"""CPU accelerator (reference ``accelerator/cpu_accelerator.py``) — used by
+CI: the test harness runs the full stack on a virtual multi-device CPU mesh
+(``--xla_force_host_platform_device_count``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+    _name = "cpu"
+    _communication_backend_name = "xla"
+
+    def device_count(self) -> int:
+        return jax.device_count()
+
+    def current_device(self) -> Any:
+        return jax.devices()[0]
+
+    def memory_stats(self, device_index: int | None = None) -> Dict[str, int]:
+        try:
+            import resource
+            peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+            return {"bytes_in_use": peak, "peak_bytes_in_use": peak}
+        except Exception:
+            return {}
+
+    def is_bf16_supported(self) -> bool:
+        return True

@@ -1,0 +1,46 @@
+"""Accelerator detection/selection (reference ``accelerator/real_accelerator.py:51``).
+
+``get_accelerator()`` picks TPU when a TPU backend is live, else CPU.
+Override with ``DS_ACCELERATOR=tpu|cpu`` (same env var as the reference).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+from ..utils.logging import logger
+
+SUPPORTED_ACCELERATOR_LIST = ["tpu", "cpu"]
+
+_accelerator: Optional[DeepSpeedAccelerator] = None
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _accelerator
+    if _accelerator is not None:
+        return _accelerator
+
+    name = os.environ.get("DS_ACCELERATOR")
+    if name is not None and name not in SUPPORTED_ACCELERATOR_LIST:
+        raise ValueError(
+            f"DS_ACCELERATOR={name!r} not in {SUPPORTED_ACCELERATOR_LIST}")
+    if name is None:
+        import jax
+        backend = jax.default_backend()
+        name = "cpu" if backend == "cpu" else "tpu"
+
+    if name == "tpu":
+        from .tpu_accelerator import TPU_Accelerator
+        _accelerator = TPU_Accelerator()
+    else:
+        from .cpu_accelerator import CPU_Accelerator
+        _accelerator = CPU_Accelerator()
+    logger.info("Setting accelerator to %s", _accelerator.device_name())
+    return _accelerator
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _accelerator
+    _accelerator = accel

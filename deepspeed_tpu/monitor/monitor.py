@@ -1,0 +1,105 @@
+"""Monitoring backends (reference ``deepspeed/monitor/``: MonitorMaster
+fanning out write_events to TensorBoard / WandB / CSV / Comet writers)."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, List, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+Event = Tuple[str, Any, int]  # (tag, value, step)
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = config.enabled
+
+    def write_events(self, event_list: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                log_dir = os.path.join(config.output_path or "./runs", config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except Exception as e:
+                logger.warning("tensorboard unavailable: %s", e)
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.summary_writer is None:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, float(value), int(step))
+        self.summary_writer.flush()
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = config.output_path or "./csv_monitor"
+        self.job_name = config.job_name
+        self._files = {}
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled or jax.process_index() != 0:
+            return
+        for tag, value, step in event_list:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 tag.replace("/", "_") + ".csv")
+            os.makedirs(os.path.dirname(fname), exist_ok=True)
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([int(step), float(value)])
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                import wandb
+                wandb.init(project=config.project or "deepspeed_tpu",
+                           group=config.group or None, team=config.team or None)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning("wandb unavailable: %s", e)
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self._wandb is None:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: value}, step=int(step))
+
+
+class MonitorMaster(Monitor):
+    """Fan-out master (reference monitor/monitor.py:30)."""
+
+    def __init__(self, ds_config):
+        self.monitors: List[Monitor] = []
+        if ds_config.tensorboard.enabled:
+            self.monitors.append(TensorBoardMonitor(ds_config.tensorboard))
+        if ds_config.csv_monitor.enabled:
+            self.monitors.append(CSVMonitor(ds_config.csv_monitor))
+        if ds_config.wandb.enabled:
+            self.monitors.append(WandbMonitor(ds_config.wandb))
+        self.enabled = any(m.enabled for m in self.monitors)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        for m in self.monitors:
+            if m.enabled:
+                m.write_events(event_list)

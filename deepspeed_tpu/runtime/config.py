@@ -1,0 +1,370 @@
+"""Typed config system.
+
+TPU-native analogue of ``deepspeed/runtime/config.py`` (``DeepSpeedConfig``,
+:706) + the pydantic ``DeepSpeedConfigModel`` pattern
+(``runtime/config_utils.py``).  Accepts a DeepSpeed-style JSON/dict config —
+the same top-level keys users already write (train_batch_size, optimizer,
+scheduler, bf16/fp16, zero_optimization, pipeline, ...) — and resolves it
+into typed sub-configs.  TPU-specific knobs live under the ``"tpu"`` key.
+
+Batch arithmetic invariant (reference config.py sanity checks):
+    train_batch_size == micro_batch_per_device * gradient_accumulation_steps
+                        * batch-parallel world size
+Any one of the three may be omitted and is inferred.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+from ..utils.logging import logger
+
+AUTO = "auto"
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base model: tolerant of unknown keys (accept+warn, so any reference
+    config parses), supports deprecated aliases via populate_by_name."""
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+    @model_validator(mode="after")
+    def _warn_extra(self):
+        extra = getattr(self, "model_extra", None) or {}
+        for k in extra:
+            logger.debug("config: unrecognized key '%s' accepted and ignored", k)
+        return self
+
+
+class OptimizerParams(DeepSpeedConfigModel):
+    lr: float = 1e-3
+    betas: List[float] = Field(default_factory=lambda: [0.9, 0.999])
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    momentum: float = 0.0  # sgd
+    bias_correction: bool = True
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "adamw"  # adam|adamw|fusedadam|lamb|lion|adagrad|sgd|onebitadam|...
+    params: OptimizerParams = Field(default_factory=OptimizerParams)
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: str = "WarmupLR"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class FP16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    auto_cast: bool = False
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = True
+    # Keep a fp32 master copy + fp32 grad accumulation (reference
+    # bf16_optimizer.py behavior). Disable to train pure-bf16.
+    master_weights: bool = True
+    accumulate_grads_in_fp32: bool = True
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class OffloadConfig(DeepSpeedConfigModel):
+    device: str = "none"  # none|cpu|nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0  # ZeRO-Offload++ partial offload (engine.py:766)
+
+
+class ZeroConfig(DeepSpeedConfigModel):
+    """``zero_optimization`` section (reference runtime/zero/config.py).
+
+    On TPU, stages map to GSPMD shardings over the 'fsdp' mesh axis:
+      stage 0: params/grads/opt-state replicated (pure DP)
+      stage 1: optimizer state + fp32 master sharded
+      stage 2: + gradients reduce-scattered into shards
+      stage 3: + parameters sharded (gathered per-layer by XLA)
+    """
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = int(5e8)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = int(5e8)
+    overlap_comm: bool = True
+    offload_param: OffloadConfig = Field(default_factory=OffloadConfig)
+    offload_optimizer: OffloadConfig = Field(default_factory=OffloadConfig)
+    sub_group_size: int = int(1e9)
+    stage3_max_live_parameters: int = int(1e9)
+    stage3_max_reuse_distance: int = int(1e9)
+    stage3_prefetch_bucket_size: int = int(5e7)
+    stage3_param_persistence_threshold: int = int(1e5)
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    zero_hpz_partition_size: int = 1  # ZeRO++ secondary partition
+    zero_quantized_weights: bool = False  # ZeRO++ qwZ
+    zero_quantized_gradients: bool = False  # ZeRO++ qgZ
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    round_robin_gradients: bool = False
+    memory_efficient_linear: bool = True
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: jax.checkpoint policy name
+    # (full | nothing | dots | dots_with_no_batch_dims | offload_dots)
+    policy: str = "full"
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: int = 1
+    partition_method: str = "parameters"  # uniform|parameters|type:regex
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    micro_batches: Optional[int] = None  # default: gradient_accumulation_steps
+
+
+class TensorParallelConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    tp_size: int = 1
+
+
+class SequenceParallelConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    sp_size: int = 1
+    mode: str = "ulysses"  # ulysses | ring
+
+
+class MoEConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    num_experts: int = 1
+    ep_size: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None  # None|Jitter|RSample
+    drop_tokens: bool = True
+    use_residual: bool = False
+    # HabanaAI capacity-bins trick (moe/capacity_bins.py) — static-shape
+    # capacity bucketing; on XLA this avoids recompilation: round the
+    # capacity up to one of num_capacity_bins precompiled bucket sizes.
+    num_capacity_bins: int = 0
+    capacity_bins_exp_base: float = 2.0
+
+
+class MonitorConfigItem(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+    team: str = ""
+    group: str = ""
+    project: str = "deepspeed_tpu"
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    debug: bool = False
+    prof_all: bool = True
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"  # Ignore|Warn|Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    async_save: bool = True  # orbax async checkpointing
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.2
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = False
+    metric: str = "throughput"
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_batch_size: Optional[int] = None
+    mp_size: int = 1
+
+
+class CompressionConfig(DeepSpeedConfigModel):
+    weight_quantization: Dict[str, Any] = Field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = Field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = Field(default_factory=dict)
+    row_pruning: Dict[str, Any] = Field(default_factory=dict)
+    head_pruning: Dict[str, Any] = Field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = Field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+
+class TPUConfig(DeepSpeedConfigModel):
+    """TPU-native extension knobs (no reference analogue)."""
+    # Mesh axis sizes; -1 = absorb remaining devices.
+    mesh: Dict[str, int] = Field(default_factory=dict)
+    # scan over homogeneous transformer layers (compile time + remat unit)
+    scan_layers: bool = True
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"  # maps to jax.checkpoint policies
+    donate_state: bool = True
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    # matmul precision: default|float32|tensorfloat32|highest
+    matmul_precision: str = "default"
+
+
+class DeepSpeedTPUConfig(DeepSpeedConfigModel):
+    """Top-level config (reference DeepSpeedConfig, runtime/config.py:706)."""
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+    steps_per_print: int = 10
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    communication_data_type: Optional[str] = None
+    seq_parallel_communication_data_type: str = "fp32"
+    sparse_gradients: bool = False
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dump_state: bool = False
+    disable_allgather: bool = False
+
+    optimizer: OptimizerConfig = Field(default_factory=OptimizerConfig)
+    scheduler: Optional[SchedulerConfig] = None
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
+    sequence_parallel: SequenceParallelConfig = Field(default_factory=SequenceParallelConfig)
+    moe: MoEConfig = Field(default_factory=MoEConfig)
+    tensorboard: MonitorConfigItem = Field(default_factory=MonitorConfigItem)
+    wandb: MonitorConfigItem = Field(default_factory=MonitorConfigItem)
+    csv_monitor: MonitorConfigItem = Field(default_factory=MonitorConfigItem)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
+    compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
+    data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+    tpu: TPUConfig = Field(default_factory=TPUConfig)
+
+    # ------------------------------------------------------------------
+    @model_validator(mode="after")
+    def _normalize(self):
+        if self.fp16.enabled and self.bf16.enabled:
+            # bf16 is the TPU-natural default; explicit fp16 wins if the user
+            # asked for it without touching bf16.
+            object.__setattr__(self.bf16, "enabled", False)
+        return self
+
+    def resolve_batch_sizes(self, batch_parallel_world: int) -> None:
+        """Enforce train_batch = micro * gas * dp (reference config sanity)."""
+        tb, mb, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                       self.gradient_accumulation_steps)
+        dp = batch_parallel_world
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp:
+                raise ValueError(
+                    f"train_batch_size {tb} != micro_batch {mb} * gas {gas} * dp {dp}")
+        elif tb is not None and mb is not None:
+            if tb % (mb * dp) != 0:
+                raise ValueError(f"train_batch_size {tb} not divisible by micro*dp {mb * dp}")
+            gas = tb // (mb * dp)
+        elif tb is not None and gas is not None:
+            if tb % (gas * dp) != 0:
+                raise ValueError(f"train_batch_size {tb} not divisible by gas*dp {gas * dp}")
+            mb = tb // (gas * dp)
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp
+        elif tb is not None:
+            gas = 1
+            if tb % dp != 0:
+                raise ValueError(f"train_batch_size {tb} not divisible by dp {dp}")
+            mb = tb // dp
+        else:
+            mb = 1
+            gas = gas or 1
+            tb = mb * gas * dp
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = mb
+        self.gradient_accumulation_steps = gas
+
+    @property
+    def precision_dtype(self) -> str:
+        if self.fp16.enabled:
+            return "float16"
+        if self.bf16.enabled:
+            return "bfloat16"
+        return "float32"
+
+
+def load_config(config: Union[str, dict, DeepSpeedTPUConfig, None]) -> DeepSpeedTPUConfig:
+    if config is None:
+        return DeepSpeedTPUConfig()
+    if isinstance(config, DeepSpeedTPUConfig):
+        return config
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    return DeepSpeedTPUConfig(**config)

@@ -1,0 +1,88 @@
+"""Data loading (reference ``runtime/dataloader.py``: DeepSpeedDataLoader,
+RepeatingLoader).
+
+Framework-agnostic: wraps any indexable dataset (numpy arrays, lists of
+dicts, torch Dataset) into batched numpy pytrees ready for the engine's
+sharded train step.  Curriculum-aware sampling plugs in via the
+``data_sampler`` argument (see runtime/data_pipeline/).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference :17)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def default_collate(samples: Sequence[Any]):
+    """Stack a list of samples (dicts/tuples/arrays) into a batched pytree."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Batched loader (reference DeepSpeedDataLoader, dataloader.py:41)."""
+
+    def __init__(self,
+                 dataset,
+                 batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 shuffle: bool = False,
+                 seed: int = 0,
+                 drop_last: bool = True,
+                 data_sampler: Optional[Any] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.data_sampler = data_sampler
+        self._epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            order = list(self.data_sampler)
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            order = rng.permutation(n).tolist()
+        else:
+            order = list(range(n))
+        self._epoch += 1
+        for i in range(0, len(order) - (self.batch_size - 1 if self.drop_last else 0),
+                       self.batch_size):
+            idx = order[i:i + self.batch_size]
+            if self.drop_last and len(idx) < self.batch_size:
+                break
+            yield self.collate_fn([self.dataset[j] for j in idx])

@@ -1,0 +1,76 @@
+"""Optimizer factory (reference ``runtime/engine.py:1330``
+``_configure_basic_optimizer``: Adam/AdamW/FusedAdam/CPUAdam/Lamb/Lion/
+OneBitAdam/OneBitLamb/ZeroOneAdam/Adagrad/SGD/Muon selection matrix).
+
+TPU-native: every optimizer is an optax gradient transformation that runs
+*inside* the jitted, sharded train step — "Fused" is the default on TPU
+(XLA fuses the update chain into a handful of kernels over the sharded
+flat buffers), so FusedAdam/Adam/CPUAdam map to the same adamw transform;
+a Pallas multi-tensor fused path exists in ``ops/fused_optimizer.py`` for
+the flat-shard fast path.  1-bit optimizers use the error-feedback
+compressed-allreduce transform from ``runtime/comm/compressed.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Union
+
+import optax
+
+from ..utils.logging import logger
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+FUSED_ADAM = "fusedadam"
+CPU_ADAM = "cpuadam"  # offload path: states on host, update on host C++ Adam
+LAMB_OPTIMIZER = "lamb"
+FUSED_LAMB = "fusedlamb"
+LION_OPTIMIZER = "lion"
+ADAGRAD_OPTIMIZER = "adagrad"
+SGD_OPTIMIZER = "sgd"
+ONEBIT_ADAM = "onebitadam"
+ONEBIT_LAMB = "onebitlamb"
+ZERO_ONE_ADAM = "zerooneadam"
+MUON = "muon"
+ADAFACTOR = "adafactor"
+
+
+def get_optimizer(name: str,
+                  params_cfg: Any,
+                  lr_schedule: Optional[Union[Callable, float]] = None
+                  ) -> optax.GradientTransformation:
+    """Build the optax transform for a DeepSpeed optimizer name."""
+    name = name.lower().replace("_", "")
+    lr = lr_schedule if lr_schedule is not None else params_cfg.lr
+    betas = tuple(params_cfg.betas)
+    eps = params_cfg.eps
+    wd = params_cfg.weight_decay
+
+    if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM, "deepspeedcpuadam"):
+        # torch.optim.Adam applies decoupled=False L2; DeepSpeed's FusedAdam
+        # defaults to adam_w_mode=True -> adamw semantics.
+        return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name == ADAMW_OPTIMIZER:
+        return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name in (LAMB_OPTIMIZER, FUSED_LAMB):
+        return optax.lamb(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name in (LION_OPTIMIZER, "fusedlion", "cpulion"):
+        b1 = betas[0] if betas else 0.9
+        b2 = betas[1] if len(betas) > 1 else 0.99
+        return optax.lion(lr, b1=b1, b2=b2, weight_decay=wd)
+    if name in (ADAGRAD_OPTIMIZER, "cpuadagrad"):
+        return optax.adagrad(lr, eps=eps)
+    if name == SGD_OPTIMIZER:
+        return optax.sgd(lr, momentum=params_cfg.momentum or None)
+    if name == ADAFACTOR:
+        return optax.adafactor(lr)
+    if name == MUON:
+        try:
+            return optax.contrib.muon(lr)
+        except Exception:
+            logger.warning("optax muon unavailable; falling back to adamw")
+            return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name in (ONEBIT_ADAM, ONEBIT_LAMB, ZERO_ONE_ADAM):
+        from .comm.compressed import onebit_optimizer
+        return onebit_optimizer(name, lr, betas=betas, eps=eps, weight_decay=wd)
+    raise ValueError(f"unsupported optimizer: {name!r}")

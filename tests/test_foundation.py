@@ -1,0 +1,167 @@
+"""Foundation tests: topology, comm facade, config, accelerator.
+
+Mirrors reference coverage in tests/unit/comm/test_dist.py and
+tests/unit/runtime/test_ds_config_dict.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from deepspeed_tpu.parallel.topology import MeshTopology, TopologyConfig, MESH_AXES
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.runtime.config import DeepSpeedTPUConfig, load_config
+from deepspeed_tpu.accelerator import get_accelerator
+
+
+class TestTopology:
+    def test_default_absorbs_devices(self):
+        topo = MeshTopology()
+        assert topo.world_size == 8
+        assert topo.config.data == 8
+        assert topo.dp_world_size == 8
+
+    def test_explicit_axes(self):
+        topo = MeshTopology(TopologyConfig(data=2, fsdp=2, tensor=2))
+        assert topo.tp_world_size == 2
+        assert topo.fsdp_world_size == 2
+        assert topo.dp_world_size == 4  # data*fsdp
+        assert topo.batch_shard_size == 4
+
+    def test_bad_divisor_raises(self):
+        with pytest.raises(ValueError):
+            MeshTopology(TopologyConfig(data=3, tensor=5))
+
+    def test_mesh_axis_names(self):
+        topo = MeshTopology(TopologyConfig(data=4, tensor=2))
+        assert topo.mesh.axis_names == MESH_AXES
+
+
+class TestComm:
+    def _mesh(self):
+        return MeshTopology(TopologyConfig(data=4, tensor=2))
+
+    def test_all_reduce(self):
+        topo = self._mesh()
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        f = shard_map(lambda v: dist.all_reduce(v, "data"),
+                      mesh=topo.mesh, in_specs=P(("data", "tensor")),
+                      out_specs=P(("data", "tensor")))
+        out = np.asarray(f(x))
+        # groups of 4 along data share the same tensor rank pattern
+        assert out.shape == (8, 1)
+
+    def test_all_gather_reduce_scatter_roundtrip(self):
+        topo = MeshTopology(TopologyConfig(data=8))
+        x = np.arange(16, dtype=np.float32).reshape(16, 1)
+
+        def body(v):
+            g = dist.all_gather(v, "data", axis=0)  # [16,1]
+            s = dist.reduce_scatter(g, "data", axis=0)  # [2,1] = 8x shard
+            return s
+
+        f = shard_map(body, mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"))
+        out = np.asarray(f(x))
+        np.testing.assert_allclose(out, x * 8)
+
+    def test_broadcast(self):
+        topo = MeshTopology(TopologyConfig(data=8))
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        f = shard_map(lambda v: dist.broadcast(v, "data", src=3),
+                      mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"))
+        out = np.asarray(f(x))
+        np.testing.assert_allclose(out, np.full((8, 1), 3.0))
+
+    def test_all_to_all(self):
+        topo = MeshTopology(TopologyConfig(data=4, tensor=2))
+        # Ulysses primitive: [seq_shard, heads] -> [seq, heads_shard]
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        f = shard_map(lambda v: dist.all_to_all(v, "data", split_axis=1, concat_axis=0),
+                      mesh=topo.mesh, in_specs=P("data", None), out_specs=P(None, "data"))
+        out = np.asarray(f(x))
+        assert out.shape == (8, 4)
+
+    def test_ppermute_ring(self):
+        topo = MeshTopology(TopologyConfig(data=4, tensor=2))
+        x = np.arange(4, dtype=np.float32).reshape(4, 1)
+        f = shard_map(lambda v: dist.send_recv_next(v, "data", 4),
+                      mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"))
+        out = np.asarray(f(x)).ravel()
+        np.testing.assert_allclose(out, [3, 0, 1, 2])
+
+    def test_host_info(self):
+        assert dist.get_world_size() == 8
+        assert dist.get_rank() == 0
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = DeepSpeedTPUConfig()
+        assert cfg.zero_optimization.stage == 0
+        assert cfg.bf16.enabled
+
+    def test_deepspeed_json_keys(self):
+        # A config in the reference's JSON dialect parses unchanged.
+        cfg = load_config({
+            "train_batch_size": 32,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "betas": [0.9, 0.95]}},
+            "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 100}},
+            "fp16": {"enabled": False},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 3, "overlap_comm": True,
+                                  "stage3_param_persistence_threshold": 1000},
+            "gradient_clipping": 1.0,
+            "wall_clock_breakdown": False,
+            "some_unknown_key": {"x": 1},
+        })
+        assert cfg.zero_optimization.stage == 3
+        assert cfg.optimizer.params.lr == 3e-4
+        assert cfg.gradient_clipping == 1.0
+
+    def test_batch_arithmetic(self):
+        cfg = load_config({"train_batch_size": 32, "gradient_accumulation_steps": 2})
+        cfg.resolve_batch_sizes(4)
+        assert cfg.train_micro_batch_size_per_gpu == 4
+
+    def test_batch_arithmetic_conflict(self):
+        cfg = load_config({"train_batch_size": 32,
+                           "train_micro_batch_size_per_gpu": 3,
+                           "gradient_accumulation_steps": 2})
+        with pytest.raises(ValueError):
+            cfg.resolve_batch_sizes(4)
+
+    def test_fp16_overrides_bf16_default(self):
+        cfg = load_config({"fp16": {"enabled": True}})
+        assert cfg.fp16.enabled and not cfg.bf16.enabled
+
+
+class TestAccelerator:
+    def test_cpu_detected(self):
+        acc = get_accelerator()
+        assert acc.device_name() == "cpu"
+        assert acc.communication_backend_name() == "xla"
+        assert acc.device_count() == 8
+        assert acc.is_bf16_supported()
+        assert acc.resolves_data_dependency()
+
+
+class TestLRSchedules:
+    def test_warmup_lr(self):
+        from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+        s = get_lr_schedule("WarmupLR", {"warmup_num_steps": 10,
+                                         "warmup_max_lr": 1.0,
+                                         "warmup_type": "linear"}, 1.0)
+        assert s(0) == 0.0
+        assert abs(s(5) - 0.5) < 1e-6
+        assert s(100) == 1.0
+
+    def test_warmup_cosine(self):
+        from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+        s = get_lr_schedule("WarmupCosineLR",
+                            {"total_num_steps": 100, "warmup_num_steps": 10}, 1e-3)
+        assert s(100) < s(50) < s(10)
