@@ -1,0 +1,75 @@
+"""Benchmark: LLaMA training throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: training tokens/sec/chip on the largest LLaMA config that fits
+(BASELINE.json target family: ZeRO-3 tokens/sec/chip).  vs_baseline is the
+achieved model FLOPs utilization (MFU) fraction, since BASELINE.json has
+no published TPU number to compare against.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+MODEL_SIZE = os.environ.get("BENCH_MODEL", "1b")
+SEQ_LEN = int(os.environ.get("BENCH_SEQ", "2048"))
+MICRO_BS = int(os.environ.get("BENCH_BS", "4"))
+STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+
+# peak bf16 FLOPs/s per chip (TPU v5e ~ 394 TFLOPs int8 / 197 bf16)
+PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+
+
+def main():
+    import jax
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+
+    n_chips = jax.device_count()
+    model = LlamaForCausalLM(MODEL_SIZE, max_seq_len=SEQ_LEN)
+    config = {
+        "train_micro_batch_size_per_gpu": MICRO_BS,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 3},
+        "bf16": {"enabled": True, "master_weights": False},
+        "steps_per_print": 10 ** 9,
+        "tpu": {"remat_policy": "nothing_saveable"},
+    }
+    engine, _, _, _ = dst.initialize(model=model, config=config)
+    bs = engine.train_batch_size()
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, model.cfg.vocab_size, size=(bs, SEQ_LEN)).astype(np.int32)}
+
+    engine.train_batch(batch)  # compile + warmup
+    engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = bs * SEQ_LEN
+    tok_s = tokens_per_step * STEPS / dt
+    tok_s_chip = tok_s / n_chips
+
+    # MFU: 6 * n_params * tokens/sec / peak (fwd+bwd), ignoring attention
+    n_params = model.cfg.n_params()
+    mfu = 6.0 * n_params * tok_s / (PEAK_FLOPS * n_chips)
+
+    print(json.dumps({
+        "metric": f"llama-{MODEL_SIZE} bf16 train tokens/sec/chip (seq {SEQ_LEN})",
+        "value": round(tok_s_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""BERT family — bidirectional encoder + MLM head (BASELINE config #1:
+BERT-base ZeRO-1 DP; reference training kernels target this class of model,
+csrc/transformer/).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import (TransformerConfig, cross_entropy_loss, forward,
+                          init_params)
+
+
+def bert_config(size: str = "base", **overrides) -> TransformerConfig:
+    presets = {
+        "base": dict(vocab_size=30522, hidden_size=768, intermediate_size=3072,
+                     num_layers=12, num_heads=12, max_seq_len=512),
+        "large": dict(vocab_size=30522, hidden_size=1024, intermediate_size=4096,
+                      num_layers=24, num_heads=16, max_seq_len=512),
+        "debug": dict(vocab_size=128, hidden_size=64, intermediate_size=256,
+                      num_layers=2, num_heads=4, max_seq_len=64),
+    }
+    base = dict(norm="layernorm", norm_eps=1e-12, activation="gelu",
+                pos_emb="learned", causal=False, tie_embeddings=True,
+                use_bias=True, dtype=jnp.bfloat16)
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+class BertForMaskedLM:
+    """Engine-protocol masked-LM.  Batch: {'input_ids', 'labels'
+    (-100/-1 = unmasked positions), optional 'attention_mask'}."""
+
+    def __init__(self, size: str = "base", **overrides):
+        self.cfg = bert_config(size, **overrides)
+
+    def init_params(self, rng):
+        return init_params(self.cfg, rng)
+
+    def logits(self, params, batch, rng=None):
+        return forward(self.cfg, params, batch["input_ids"],
+                       attention_mask=batch.get("attention_mask"))
+
+    def loss(self, params, batch, rng=None):
+        logits = self.logits(params, batch, rng)
+        labels = batch["labels"]
+        labels = jnp.where(labels == -100, -1, labels)  # HF convention
+        return cross_entropy_loss(logits, labels, batch.get("attention_mask"))

@@ -1,0 +1,396 @@
+"""Functional transformer core — shared implementation behind the model
+families (LLaMA, GPT, BERT, Mixtral presets in sibling modules).
+
+TPU-native design choices (cf. reference per-arch containers in
+``deepspeed/module_inject/containers/`` and inference-v2 model
+implementations ``inference/v2/model_implementations/``):
+
+* **Pure functions over pytrees** — params are nested dicts of arrays
+  boxed with ``flax.core.meta.Partitioned`` logical axis names
+  ('embed', 'heads', 'kv', 'mlp', 'vocab', 'layers', 'norm'); the ZeRO
+  partitioner maps names -> mesh axes per parallelism config.
+* **Stacked layers + lax.scan** — all transformer layers live in one
+  stacked tree (leading 'layers' dim).  One compile of the layer body,
+  O(1) HLO size in depth, and ``jax.checkpoint`` on the body is the
+  activation-checkpointing unit (reference
+  ``runtime/activation_checkpointing/checkpointing.py`` becomes a remat
+  policy).
+* **Sequence parallelism as sharding constraints** — Ulysses' two
+  all-to-alls (reference ``sequence/layer.py:65`` DistributedAttention)
+  are expressed by resharding activations seq-sharded -> head-sharded
+  around attention; XLA inserts the all-to-alls on the 'seq' axis.
+* **bf16 compute, fp32 softmax/normalization accumulations.**
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax.core import meta
+from jax.sharding import PartitionSpec as P
+
+BATCH = ("data", "expert", "fsdp")  # batch-dim mesh axes (topology.BATCH_AXES)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # None -> MHA
+    head_dim: Optional[int] = None      # None -> hidden/heads
+    max_seq_len: int = 4096
+    norm: str = "rmsnorm"              # rmsnorm | layernorm
+    norm_eps: float = 1e-5
+    activation: str = "silu_gated"     # silu_gated | gelu | gelu_gated
+    pos_emb: str = "rope"              # rope | learned | none
+    rope_theta: float = 10000.0
+    causal: bool = True
+    tie_embeddings: bool = False
+    use_bias: bool = False
+    dropout: float = 0.0
+    scan_layers: bool = True
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dims_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def n_params(self) -> int:
+        e, f, l, v = self.hidden_size, self.intermediate_size, self.num_layers, self.vocab_size
+        h, k, d = self.num_heads, self.kv_heads, self.dims_per_head
+        attn = e * h * d + 2 * e * k * d + h * d * e
+        mlp = e * f * (3 if "gated" in self.activation else 2)
+        return l * (attn + mlp) + v * e * (1 if self.tie_embeddings else 2)
+
+
+# ---------------------------------------------------------------------------
+# param construction
+# ---------------------------------------------------------------------------
+
+def _boxed(value: jax.Array, names: Tuple[Optional[str], ...]):
+    return meta.Partitioned(value, names=names)
+
+
+def _dense_init(rng, shape, fan_in, dtype=jnp.float32):
+    return jax.random.normal(rng, shape, dtype) * (fan_in ** -0.5)
+
+
+def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
+    """Initialize (boxed) parameters; stacked over layers when scanning."""
+    e, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    h, k, d = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+    L = cfg.num_layers
+    keys = jax.random.split(rng, 12)
+
+    def stack(init_one):
+        """init per-layer then stack (scan) or keep list-of-dicts."""
+        ps = [init_one(jax.random.fold_in(keys[0], i)) for i in range(L)]
+        if cfg.scan_layers:
+            return jax.tree.map(
+                lambda *xs: _boxed(jnp.stack([x.value for x in xs]),
+                                   ("layers",) + xs[0].names),
+                *ps,
+                is_leaf=lambda x: isinstance(x, meta.Partitioned))
+        return {f"layer_{i}": p for i, p in enumerate(ps)}
+
+    def layer_init(key):
+        ks = jax.random.split(key, 8)
+        p = {
+            "attn": {
+                "wq": _boxed(_dense_init(ks[0], (e, h, d), e), ("embed", "heads", None)),
+                "wk": _boxed(_dense_init(ks[1], (e, k, d), e), ("embed", "kv", None)),
+                "wv": _boxed(_dense_init(ks[2], (e, k, d), e), ("embed", "kv", None)),
+                "wo": _boxed(_dense_init(ks[3], (h, d, e), h * d), ("heads", None, "embed")),
+            },
+            "mlp": {
+                "wi": _boxed(_dense_init(ks[4], (e, f), e), ("embed", "mlp")),
+                "wo": _boxed(_dense_init(ks[5], (f, e), f), ("mlp", "embed")),
+            },
+            "norm1": _norm_init(cfg, e),
+            "norm2": _norm_init(cfg, e),
+        }
+        if "gated" in cfg.activation:
+            p["mlp"]["wg"] = _boxed(_dense_init(ks[6], (e, f), e), ("embed", "mlp"))
+        if cfg.use_bias:
+            p["attn"]["bq"] = _boxed(jnp.zeros((h, d)), ("heads", None))
+            p["attn"]["bk"] = _boxed(jnp.zeros((k, d)), ("kv", None))
+            p["attn"]["bv"] = _boxed(jnp.zeros((k, d)), ("kv", None))
+            p["attn"]["bo"] = _boxed(jnp.zeros((e,)), ("embed",))
+            p["mlp"]["bi"] = _boxed(jnp.zeros((f,)), ("mlp",))
+            p["mlp"]["bo"] = _boxed(jnp.zeros((e,)), ("embed",))
+        return p
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": _boxed(
+            jax.random.normal(keys[1], (v, e)) * 0.02, ("vocab", "embed"))},
+        "layers": stack(layer_init),
+        "final_norm": _norm_init(cfg, e),
+    }
+    if cfg.pos_emb == "learned":
+        params["embed"]["positions"] = _boxed(
+            jax.random.normal(keys[2], (cfg.max_seq_len, e)) * 0.02, (None, "embed"))
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _boxed(_dense_init(keys[3], (e, v), e), ("embed", "vocab"))
+    return params
+
+
+def _norm_init(cfg: TransformerConfig, dim: int):
+    p = {"scale": _boxed(jnp.ones((dim,)), ("norm",))}
+    if cfg.norm == "layernorm":
+        p["bias"] = _boxed(jnp.zeros((dim,)), ("norm",))
+    return p
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _constrain(x: jax.Array, *spec) -> jax.Array:
+    """Sharding constraint that degrades to no-op outside a mesh context."""
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec))
+    except (ValueError, RuntimeError):
+        return x
+
+
+def _norm_apply(cfg: TransformerConfig, p, x: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + cfg.norm_eps) * p["scale"].astype(jnp.float32)
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+        y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rope_table(cfg: TransformerConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    d = cfg.dims_per_head
+    freqs = cfg.rope_theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,d/2]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """x: [B,S,H,D]; interleaved-pair rotation in fp32."""
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    sin, cos = sin[:, :, None, :], cos[:, :, None, :]
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def _activation(cfg: TransformerConfig, gate, up):
+    if cfg.activation == "silu_gated":
+        return jax.nn.silu(gate) * up
+    if cfg.activation == "gelu_gated":
+        return jax.nn.gelu(gate) * up
+    return jax.nn.gelu(up)
+
+
+def dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v,
+                          mask: Optional[jax.Array]) -> jax.Array:
+    """Grouped-query attention, fp32 softmax.  q: [B,S,H,D], k/v: [B,S,K,D].
+
+    Hot op #1 (reference csrc/transformer softmax/attention kernels); the
+    Pallas flash kernel in ops/flash_attention.py replaces this einsum
+    formulation on TPU when seq_len crosses the flash threshold.
+    """
+    b, s, hq, dd = q.shape
+    k_heads = kv_k.shape[2]
+    groups = hq // k_heads
+    q = q.reshape(b, s, k_heads, groups, dd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", q, kv_k) / np.sqrt(dd)
+    scores = scores.astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(kv_v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, kv_v)
+    return out.reshape(b, s, hq, dd)
+
+
+def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask):
+    dtype = cfg.dtype
+    wq, wk, wv, wo = (p["wq"].astype(dtype), p["wk"].astype(dtype),
+                      p["wv"].astype(dtype), p["wo"].astype(dtype))
+    q = jnp.einsum("bse,ehd->bshd", x, wq)
+    k = jnp.einsum("bse,ekd->bskd", x, wk)
+    v = jnp.einsum("bse,ekd->bskd", x, wv)
+    if cfg.use_bias:
+        q = q + p["bq"].astype(dtype)
+        k = k + p["bk"].astype(dtype)
+        v = v + p["bv"].astype(dtype)
+    if cfg.pos_emb == "rope":
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+    # Ulysses resharding: tokens seq-sharded -> heads ('seq'+'tensor')-sharded.
+    # XLA materializes this as the two all-to-alls of reference
+    # sequence/layer.py:65, but fused into the surrounding program.
+    q = _constrain(q, BATCH, None, ("seq", "tensor"), None)
+    k = _constrain(k, BATCH, None, ("seq", "tensor") if cfg.kv_heads > 1 else None, None)
+    v = _constrain(v, BATCH, None, ("seq", "tensor") if cfg.kv_heads > 1 else None, None)
+    out = dot_product_attention(cfg, q, k, v, mask)
+    out = jnp.einsum("bshd,hde->bse", out, wo)
+    if cfg.use_bias:
+        out = out + p["bo"].astype(dtype)
+    return _constrain(out, BATCH, "seq", None)
+
+
+def _mlp_block(cfg: TransformerConfig, p, x):
+    dtype = cfg.dtype
+    up = jnp.einsum("bse,ef->bsf", x, p["wi"].astype(dtype))
+    if cfg.use_bias:
+        up = up + p["bi"].astype(dtype)
+    gate = jnp.einsum("bse,ef->bsf", x, p["wg"].astype(dtype)) \
+        if "wg" in p else None
+    h = _activation(cfg, gate, up) if gate is not None else _activation(cfg, None, up)
+    h = _constrain(h, BATCH, "seq", "tensor")
+    out = jnp.einsum("bsf,fe->bse", h, p["wo"].astype(dtype))
+    if cfg.use_bias:
+        out = out + p["bo"].astype(dtype)
+    return _constrain(out, BATCH, "seq", None)
+
+
+def _layer_body(cfg: TransformerConfig, layer_params, x, sin, cos, mask,
+                mlp_fn=None):
+    h = _norm_apply(cfg, layer_params["norm1"], x)
+    x = x + _attention_block(cfg, layer_params["attn"], h, sin, cos, mask)
+    h = _norm_apply(cfg, layer_params["norm2"], x)
+    mlp_out = (mlp_fn or _mlp_block)(cfg, layer_params["mlp"], h)
+    return x + mlp_out
+
+
+_REMAT_POLICIES = {
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
+            positions: Optional[jax.Array] = None,
+            attention_mask: Optional[jax.Array] = None,
+            mlp_fn=None) -> jax.Array:
+    """Token ids [B,S] -> logits [B,S,V] (fp32)."""
+    params = meta.unbox(params) if _has_boxes(params) else params
+    b, s = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    x = params["embed"]["tokens"].astype(cfg.dtype)[input_ids]
+    if cfg.pos_emb == "learned":
+        x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
+    x = _constrain(x, BATCH, "seq", None)
+
+    # mask: [B, S(q), S(k)]
+    if cfg.causal:
+        causal = positions[:, :, None] >= positions[:, None, :]
+        mask = causal
+    else:
+        mask = jnp.ones((b, s, s), bool)
+    if attention_mask is not None:
+        mask = mask & attention_mask[:, None, :].astype(bool)
+
+    sin, cos = rope_table(cfg, positions) if cfg.pos_emb == "rope" else (None, None)
+
+    body = functools.partial(_layer_body, cfg, mlp_fn=mlp_fn) \
+        if mlp_fn is not None else functools.partial(_layer_body, cfg)
+
+    if cfg.scan_layers:
+        def scan_body(carry, layer_params):
+            y = body(layer_params, carry, sin, cos, mask)
+            return y, None
+        if cfg.remat:
+            policy = _REMAT_POLICIES[cfg.remat_policy]
+            scan_body = jax.checkpoint(scan_body, policy=policy,
+                                       prevent_cse=False)
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    else:
+        for i in range(cfg.num_layers):
+            lp = params["layers"][f"layer_{i}"]
+            fn = body
+            if cfg.remat:
+                fn = jax.checkpoint(body, policy=_REMAT_POLICIES[cfg.remat_policy],
+                                    prevent_cse=False)
+            x = fn(lp, x, sin, cos, mask)
+
+    x = _norm_apply(cfg, params["final_norm"], x)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bse,ve->bsv", x, params["embed"]["tokens"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(cfg.dtype))
+    logits = _constrain(logits, BATCH, "seq", "tensor")
+    return logits.astype(jnp.float32)
+
+
+def _has_boxes(params) -> bool:
+    found = False
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, meta.Partitioned)):
+        if isinstance(leaf, meta.Partitioned):
+            found = True
+        break
+    return found
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       mask: Optional[jax.Array] = None) -> jax.Array:
+    """Token-level CE in fp32; labels < 0 are ignored."""
+    valid = labels >= 0 if mask is None else (mask.astype(bool) & (labels >= 0))
+    safe_labels = jnp.maximum(labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+class CausalLM:
+    """Engine-protocol causal LM over the transformer core.  Batch dict:
+    {'input_ids': [B,S] int32, optional 'labels' (default: shifted inputs),
+    optional 'attention_mask'}."""
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    def init_params(self, rng):
+        return init_params(self.cfg, rng)
+
+    def logits(self, params, batch, rng=None):
+        return forward(self.cfg, params, batch["input_ids"],
+                       positions=batch.get("positions"),
+                       attention_mask=batch.get("attention_mask"))
+
+    def loss(self, params, batch, rng=None):
+        logits = self.logits(params, batch, rng)
+        if "labels" in batch:
+            labels = batch["labels"]
+            return cross_entropy_loss(logits, labels,
+                                      batch.get("attention_mask"))
+        # next-token prediction: shift
+        labels = batch["input_ids"][:, 1:]
+        mask = batch.get("attention_mask")
+        return cross_entropy_loss(logits[:, :-1], labels,
+                                  mask[:, 1:] if mask is not None else None)

@@ -35,6 +35,7 @@ partitioner approximates with round-robin slicing (stage_1_and_2.py:643).
 
 from __future__ import annotations
 
+import math
 from typing import Any, Optional, Tuple
 
 import jax
@@ -70,17 +71,22 @@ def _largest_divisible_dim(shape: Tuple[int, ...], divisor: int,
 
 
 def add_fsdp_axis(spec: P, shape: Tuple[int, ...], fsdp_size: int,
-                  min_size: int = 2 ** 12) -> P:
+                  min_size: int = 2 ** 12,
+                  blocked_dims: Optional[set] = None) -> P:
     """Augment a (possibly tensor-parallel) spec with 'fsdp' sharding on the
     largest still-unsharded divisible dim.  Tiny params (< min_size elems,
     cf. stage3_param_persistence_threshold) stay replicated — gathering
-    them is cheaper than the latency of a tiny collective."""
+    them is cheaper than the latency of a tiny collective.
+    ``blocked_dims``: dims that must stay unsharded (e.g. the stacked
+    'layers' dim that lax.scan slices per iteration)."""
     if fsdp_size <= 1:
         return spec
     if int(np.prod(shape)) < min_size:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
     taken = {i for i, e in enumerate(entries) if e is not None}
+    if blocked_dims:
+        taken |= blocked_dims
     dim = _largest_divisible_dim(shape, fsdp_size, taken)
     if dim is None:
         return spec
@@ -138,11 +144,30 @@ class ZeroPartitioner:
 
     # -- per-leaf specs ---------------------------------------------------
     def _base_spec(self, leaf: Any) -> P:
-        """TP/EP sharding from logical-axis metadata, if present."""
+        """TP/EP sharding from logical-axis metadata, if present.  Axis
+        entries that do not divide the dim size are dropped (e.g. 8 KV heads
+        under tp=16 stay replicated, as reference AutoTP keeps indivisible
+        modules unsharded, auto_tp.py)."""
         names = getattr(leaf, "names", None)
-        if names:  # flax nn.Partitioned boxed leaf
-            return logical_to_mesh_spec(tuple(names), self.rules)
-        return P()
+        if not names:
+            return P()
+        spec = logical_to_mesh_spec(tuple(names), self.rules)
+        shape = np.shape(getattr(leaf, "value", leaf))
+        entries = []
+        for i, entry in enumerate(spec):
+            if entry is None:
+                entries.append(None)
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            size = math.prod(self.topology.axis_size(a) for a in axes)
+            entries.append(entry if i < len(shape) and shape[i] % size == 0 else None)
+        return P(*entries)
+
+    def _blocked_dims(self, leaf: Any) -> set:
+        names = getattr(leaf, "names", None)
+        if not names:
+            return set()
+        return {i for i, n in enumerate(names) if n == "layers"}
 
     def param_spec(self, leaf: Any) -> P:
         """Sharding of the model parameters used in fwd/bwd."""
@@ -150,7 +175,8 @@ class ZeroPartitioner:
         shape = np.shape(getattr(leaf, "value", leaf))
         if self.stage >= 3:
             spec = add_fsdp_axis(spec, shape, self.topology.fsdp_world_size,
-                                 self.persistence_threshold)
+                                 self.persistence_threshold,
+                                 blocked_dims=self._blocked_dims(leaf))
         return spec
 
     def master_spec(self, leaf: Any) -> P:
@@ -159,7 +185,8 @@ class ZeroPartitioner:
         shape = np.shape(getattr(leaf, "value", leaf))
         if self.stage >= 1:
             spec = add_fsdp_axis(spec, shape, self.topology.fsdp_world_size,
-                                 min_size=2)  # shard even small opt state
+                                 min_size=2,  # shard even small opt state
+                                 blocked_dims=self._blocked_dims(leaf))
         return spec
 
     def grad_spec(self, leaf: Any) -> P:
