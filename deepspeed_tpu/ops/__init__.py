@@ -1,0 +1,10 @@
+from .flash_attention import flash_attention, mha_reference  # noqa: F401
+from .fused_optimizer import fused_adamw, fused_adamw_flat  # noqa: F401
+from .normalization import layernorm, rmsnorm  # noqa: F401
+from .quantization import (  # noqa: F401
+    dequantize_blockwise,
+    quantize_blockwise,
+    quantize_dequantize,
+    quantized_all_gather,
+    quantized_psum_scatter,
+)

@@ -1,0 +1,310 @@
+"""Flash attention — Pallas TPU kernel.
+
+TPU-native replacement for the reference's fused attention kernels
+(``csrc/transformer/`` softmax/attention CUDA kernels and the
+``blocked_flash`` FastGen path, ``inference/v2/kernels/ragged_ops/``):
+blockwise softmax with running max/denominator so the S x S score matrix
+never materializes in HBM.
+
+Layout: q, k, v are [B, H, S, D] (callers fold GQA groups into H).
+Causal masking skips fully-masked k-blocks.  Backward is the standard
+two-kernel flash backward (dkv sweep over q-blocks, dq sweep over
+k-blocks) with the delta = rowsum(dO * O) precomputation.
+
+On non-TPU backends (CI) the public entry point falls back to a jnp
+reference implementation with identical semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+# ---------------------------------------------------------------------------
+# reference (and CPU fallback)
+# ---------------------------------------------------------------------------
+
+def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
+    """[B,H,S,D] attention in fp32 softmax — semantics ground truth."""
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2:]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_k, seq_k):
+    q_idx = pl.program_id(2)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:]  # [block_q, d]
+
+    num_k = pl.cdiv(seq_k, block_k)
+    if causal:
+        # highest k block that intersects this q block's diagonal
+        num_k = jnp.minimum(num_k, (q_idx + 1) * block_q // block_k
+                            + ((q_idx + 1) * block_q % block_k != 0))
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(ki, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[pl.ds(ki * block_k, block_k), :]  # [block_k, d]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    grid = (b, h, pl.cdiv(s_q, block_q))
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                               block_k=block_k, seq_k=s_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, s_k, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s_k, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, seq_q):
+    k_idx = pl.program_id(2)
+    block_k = k_ref.shape[0]
+    d = k_ref.shape[1]
+    k = k_ref[:]
+    v = v_ref[:]
+
+    num_q = pl.cdiv(seq_q, block_q)
+    q0 = jnp.int32(0)
+    if causal:
+        q0 = (k_idx * block_k) // block_q  # first q block on/under diagonal
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(qi * block_q, block_q), :]
+        do = do_ref[pl.ds(qi * block_q, block_q), :]
+        lse = lse_ref[pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(q0, num_q, body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, sm_scale, causal, block_k, seq_k):
+    q_idx = pl.program_id(2)
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    q = q_ref[:]
+    do = do_ref[:]
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+
+    num_k = pl.cdiv(seq_k, block_k)
+    if causal:
+        num_k = jnp.minimum(num_k, (q_idx + 1) * block_q // block_k
+                            + ((q_idx + 1) * block_q % block_k != 0))
+
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(ki, dq):
+        k = k_ref[pl.ds(ki * block_k, block_k), :]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_k, body, dq0)
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
+    q, k, v, out, lse = res
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                                   causal=causal, block_q=block_q, seq_q=s_q)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, pl.cdiv(s_k, block_k)),
+        in_specs=[
+            pl.BlockSpec((None, None, s_q, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, s_q, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s_q), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((None, None, s_q), lambda bi, hi, ki: (bi, hi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                                  causal=causal, block_k=block_k, seq_k=s_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, pl.cdiv(s_q, block_q)),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, s_k, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s_k, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((None, None, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_attention_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 512,
+                    block_k: int = 512,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Blockwise attention, [B,H,S,D].  GQA callers fold groups into H or
+    repeat kv.  Falls back to the jnp reference off-TPU."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        backend = jax.default_backend()
+        if backend != "tpu":
+            return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+        interpret = False
+    return _flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret)

@@ -1,0 +1,132 @@
+"""Blockwise quantization kernels + quantized collectives.
+
+Reference: ``csrc/quantization/`` (block int4/int8 quant/dequant, fused
+dequant-reduce for ZeRO++ qgZ), ``csrc/fp_quantizer/`` (FP8/FP6/FP4), and
+``runtime/comm/coalesced_collectives.py:31`` ``all_to_all_quant_reduce``.
+
+TPU-native: symmetric per-block int8 quantization as a Pallas kernel
+(scales in fp32, one block per row group), plus a *quantized gradient
+psum* built from shard_map-level collectives (quantize -> all_to_all ->
+local reduce -> requantize -> all_gather), the EQuARX-style recipe
+(PAPERS.md: arXiv 2506.17615) that replaces ZeRO++'s CUDA qgZ pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 512  # quantization group size (reference default 512/2048)
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:].astype(jnp.float32)            # [rows, BLOCK]
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127)
+    q_ref[:] = q.astype(jnp.int8)
+    s_ref[:] = scale[:, 0]
+
+
+def _dequant_kernel(q_ref, s_ref, o_ref):
+    o_ref[:] = (q_ref[:].astype(jnp.float32)
+                * s_ref[:][:, None]).astype(o_ref.dtype)
+
+
+def quantize_blockwise(x: jax.Array, block: int = BLOCK,
+                       interpret: Optional[bool] = None
+                       ) -> Tuple[jax.Array, jax.Array, int]:
+    """Flat fp tensor -> (int8 values [rows, block], fp32 scales [rows], pad)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    flat = x.ravel()
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    rows = flat.shape[0] // block
+    x2 = flat.reshape(rows, block)
+    q, s = pl.pallas_call(
+        _quant_kernel,
+        out_shape=[jax.ShapeDtypeStruct((rows, block), jnp.int8),
+                   jax.ShapeDtypeStruct((rows,), jnp.float32)],
+        interpret=interpret,
+    )(x2)
+    return q, s, pad
+
+
+def dequantize_blockwise(q: jax.Array, s: jax.Array, pad: int,
+                         shape, dtype=jnp.float32,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        _dequant_kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, dtype),
+        interpret=interpret,
+    )(q, s)
+    flat = out.ravel()
+    if pad:
+        flat = flat[:-pad]
+    return flat.reshape(shape)
+
+
+def quantize_dequantize(x: jax.Array, block: int = BLOCK) -> jax.Array:
+    """Fake-quant roundtrip (reference fake_quantizer.cu) — QAT + tests."""
+    q, s, pad = quantize_blockwise(x, block)
+    return dequantize_blockwise(q, s, pad, x.shape, x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# quantized collectives (ZeRO++ qgZ / EQuARX recipe)
+# ---------------------------------------------------------------------------
+
+def quantized_psum_scatter(x: jax.Array, axis_name: str,
+                           block: int = BLOCK) -> jax.Array:
+    """int8-compressed reduce-scatter along mesh axis (shard_map context).
+
+    Wire format: each rank quantizes its full buffer once (int8 + fp32
+    scales = ~4.03 bits/elem wire cost vs 32), all_to_alls shards, then
+    dequant-reduces locally — one quantization error per hop, matching
+    ZeRO++'s 4x gradient-communication reduction.
+    x: [N, ...] with N divisible by the axis size; returns [N/P, ...].
+    """
+    p = lax.axis_size(axis_name)
+    shard = x.shape[0] // p
+    q, s, pad = quantize_blockwise(x, block)
+    # ship int8 payloads + scales to the owning rank
+    rows_per_shard = q.shape[0] // p
+    if q.shape[0] % p != 0:
+        # fall back: unquantized psum_scatter when blocks straddle shards
+        return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    q_t = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    s_t = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    # local dequant + reduce over the P received copies
+    q_r = q_t.reshape(p, rows_per_shard, q.shape[1])
+    s_r = s_t.reshape(p, rows_per_shard)
+    vals = q_r.astype(jnp.float32) * s_r[..., None]
+    red = vals.sum(axis=0).ravel()
+    total = shard * int(np.prod(x.shape[1:]))
+    red = red[:total]
+    return red.reshape((shard,) + x.shape[1:]).astype(x.dtype)
+
+
+def quantized_all_gather(x: jax.Array, axis_name: str,
+                         block: int = BLOCK) -> jax.Array:
+    """int8-compressed all-gather (ZeRO++ qwZ weight gather)."""
+    q, s, pad = quantize_blockwise(x, block)
+    qg = lax.all_gather(q, axis_name, axis=0, tiled=True)
+    sg = lax.all_gather(s, axis_name, axis=0, tiled=True)
+    p = lax.axis_size(axis_name)
+    flat = (qg.astype(jnp.float32) * sg[:, None]).ravel()
+    n = x.size
+    per = q.size  # padded elements per rank
+    chunks = flat.reshape(p, per)[:, :n] if pad else flat.reshape(p, n)
+    return chunks.reshape((p * x.shape[0],) + x.shape[1:]).astype(x.dtype)

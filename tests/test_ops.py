@@ -1,0 +1,176 @@
+"""Kernel numeric-parity tests (reference tests/unit/ops/*): Pallas kernels
+in interpret mode vs jnp ground truth."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.flash_attention import (_flash_attention, flash_attention,
+                                               mha_reference)
+from deepspeed_tpu.ops.fused_optimizer import fused_adamw, fused_adamw_flat
+from deepspeed_tpu.ops.normalization import layernorm, rmsnorm
+from deepspeed_tpu.ops.quantization import (dequantize_blockwise,
+                                            quantize_blockwise,
+                                            quantize_dequantize,
+                                            quantized_psum_scatter)
+
+
+def rand(*shape, dtype=jnp.float32, seed=0):
+    return jax.random.normal(jax.random.key(seed), shape, dtype)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_reference(self, causal):
+        q = rand(1, 2, 128, 64, seed=1)
+        k = rand(1, 2, 128, 64, seed=2)
+        v = rand(1, 2, 128, 64, seed=3)
+        ref = mha_reference(q, k, v, causal=causal)
+        out = _flash_attention(q, k, v, 64 ** -0.5, causal, 64, 64, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+
+    def test_backward_matches_reference(self):
+        q = rand(1, 1, 128, 32, seed=1)
+        k = rand(1, 1, 128, 32, seed=2)
+        v = rand(1, 1, 128, 32, seed=3)
+
+        def loss_flash(q, k, v):
+            return _flash_attention(q, k, v, 32 ** -0.5, True, 64, 64, True).sum()
+
+        def loss_ref(q, k, v):
+            return mha_reference(q, k, v, causal=True).sum()
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3, rtol=5e-3)
+
+    def test_uneven_blocks(self):
+        q = rand(1, 1, 96, 32, seed=1)
+        k = rand(1, 1, 96, 32, seed=2)
+        v = rand(1, 1, 96, 32, seed=3)
+        ref = mha_reference(q, k, v, causal=True)
+        out = _flash_attention(q, k, v, 32 ** -0.5, True, 64, 32, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+
+    def test_cpu_fallback_dispatches(self):
+        q = rand(1, 1, 32, 16)
+        out = flash_attention(q, q, q, causal=True)
+        ref = mha_reference(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+class TestFusedAdam:
+    def test_flat_matches_optax(self):
+        import optax
+        n = 3000  # not a multiple of lane width -> exercises padding
+        p = np.asarray(rand(n, seed=1))
+        g = np.asarray(rand(n, seed=2))
+        m = np.zeros(n, np.float32)
+        v = np.zeros(n, np.float32)
+        lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-8, 0.01
+
+        p1, m1, v1 = fused_adamw_flat(jnp.asarray(p), jnp.asarray(g),
+                                      jnp.asarray(m), jnp.asarray(v),
+                                      lr, b1, b2, eps, wd, 1.0, interpret=True)
+        tx = optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=wd)
+        st = tx.init(jnp.asarray(p))
+        upd, _ = tx.update(jnp.asarray(g), st, jnp.asarray(p))
+        p2 = jnp.asarray(p) + upd
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_transform_multi_step(self):
+        import optax
+        params = {"a": rand(64, 64, seed=1), "b": rand(100, seed=2)}
+        grads = {"a": rand(64, 64, seed=3), "b": rand(100, seed=4)}
+        tx_f = fused_adamw(1e-2, weight_decay=0.01)
+        tx_o = optax.adamw(1e-2, weight_decay=0.01)
+        sf, so = tx_f.init(params), tx_o.init(params)
+        pf = po = params
+        for _ in range(3):
+            uf, sf = tx_f.update(grads, sf, pf)
+            pf = optax.apply_updates(pf, uf)
+            uo, so = tx_o.update(grads, so, po)
+            po = optax.apply_updates(po, uo)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(pf[k]), np.asarray(po[k]),
+                                       atol=1e-5, rtol=1e-5)
+
+
+class TestNorms:
+    def test_rmsnorm(self):
+        x = rand(4, 32, 256, seed=1)
+        w = np.asarray(rand(256, seed=2)) + 1.0
+        out = rmsnorm(x, jnp.asarray(w), interpret=True)
+        x32 = np.asarray(x, np.float32)
+        ref = x32 / np.sqrt((x32 ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+    def test_rmsnorm_fused_residual(self):
+        x = rand(8, 128, seed=1)
+        r = rand(8, 128, seed=2)
+        w = jnp.ones((128,))
+        out, new_res = rmsnorm(x, w, residual=r, interpret=True)
+        s = np.asarray(x) + np.asarray(r)
+        np.testing.assert_allclose(np.asarray(new_res), s, atol=1e-6)
+        ref = s / np.sqrt((s ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+    def test_layernorm(self):
+        x = rand(16, 128, seed=1)
+        w = np.asarray(rand(128, seed=2)) + 1.0
+        b = np.asarray(rand(128, seed=3))
+        out = layernorm(x, jnp.asarray(w), jnp.asarray(b), interpret=True)
+        x32 = np.asarray(x, np.float32)
+        mu = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        ref = (x32 - mu) / np.sqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+
+class TestQuantization:
+    def test_roundtrip_error_small(self):
+        x = rand(10000, seed=1)
+        y = quantize_dequantize(x, block=512)
+        err = np.abs(np.asarray(x) - np.asarray(y)).max()
+        scale = np.abs(np.asarray(x)).max() / 127
+        assert err <= scale * 1.01
+
+    def test_quant_shapes(self):
+        x = rand(1000, seed=1)  # pad to 2 blocks of 512
+        q, s, pad = quantize_blockwise(x, block=512)
+        assert q.shape == (2, 512) and s.shape == (2,) and pad == 24
+        y = dequantize_blockwise(q, s, pad, x.shape)
+        assert y.shape == x.shape
+
+    def test_quantized_psum_scatter(self):
+        """Each rank holds a full gradient buffer (8 blocks); reduce-scatter
+        leaves each rank its 1-block shard of the quantized sum."""
+        from deepspeed_tpu.parallel.topology import MeshTopology, TopologyConfig
+        topo = MeshTopology(TopologyConfig(data=8))
+        P_ = 8
+        n_local = P_ * 512
+        x = np.asarray(rand(P_ * n_local, seed=5))  # global: one buffer/rank
+
+        # check_vma=False: pallas out_shapes carry no vma info
+        f = shard_map(
+            lambda v: quantized_psum_scatter(v, "data", block=512),
+            mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False)
+        out = np.asarray(f(x)).reshape(P_, 512)
+        # reference: rank r's output = sum over source ranks of the
+        # fake-quantized block r of that rank's buffer
+        xs = x.reshape(P_, P_, 512)
+        deq = np.stack([
+            np.asarray(quantize_dequantize(jnp.asarray(xs[r].ravel()), 512)
+                       ).reshape(P_, 512)
+            for r in range(P_)])
+        ref = deq.sum(axis=0)  # [block r, 512] summed over source ranks
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
