@@ -1,0 +1,101 @@
+"""Mixtral — sparse-MoE LLaMA-style decoder (BASELINE config #4:
+Mixtral-8x7B expert parallel; reference inference impl
+``inference/v2/model_implementations/mixtral/``, training MoE via
+``deepspeed/moe/``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+from ..moe.layer import MoEConfig, init_moe_params, moe_forward
+from .transformer import (CausalLM, TransformerConfig, cross_entropy_loss,
+                          forward, init_params)
+
+
+def mixtral_config(size: str = "8x7b", **overrides) -> TransformerConfig:
+    presets = {
+        "8x7b": dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                     num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=4096),
+        "tiny": dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                     num_layers=4, num_heads=4, num_kv_heads=2, max_seq_len=256),
+        "debug": dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=64),
+    }
+    base = dict(norm="rmsnorm", norm_eps=1e-5, activation="silu_gated",
+                pos_emb="rope", causal=True, tie_embeddings=False,
+                use_bias=False, dtype=jnp.bfloat16)
+    base.update(presets[size])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+class MixtralForCausalLM(CausalLM):
+    """LLaMA backbone with the dense MLP swapped for a top-2 MoE."""
+
+    def __init__(self, size: str = "8x7b", num_experts: int = 8,
+                 top_k: int = 2, moe_overrides: Dict[str, Any] = None,
+                 **overrides):
+        super().__init__(mixtral_config(size, **overrides))
+        moe_kw = dict(num_experts=num_experts, top_k=top_k,
+                      activation=self.cfg.activation)
+        moe_kw.update(moe_overrides or {})
+        self.moe_cfg = MoEConfig(**moe_kw)
+
+    def init_params(self, rng):
+        params = init_params(self.cfg, rng)
+        # swap each layer's dense mlp for MoE params (stacked over layers)
+        L = self.cfg.num_layers
+        moe_rngs = [jax.random.fold_in(rng, 10_000 + i) for i in range(L)]
+        per_layer = [init_moe_params(self.moe_cfg, self.cfg.hidden_size,
+                                     self.cfg.intermediate_size, r)
+                     for r in moe_rngs]
+        if self.cfg.scan_layers:
+            stacked = jax.tree.map(
+                lambda *xs: meta.Partitioned(
+                    jnp.stack([x.value for x in xs]),
+                    names=("layers",) + xs[0].names),
+                *per_layer,
+                is_leaf=lambda x: isinstance(x, meta.Partitioned))
+            params["layers"]["mlp"] = stacked
+        else:
+            for i in range(L):
+                params["layers"][f"layer_{i}"]["mlp"] = per_layer[i]
+        return params
+
+    def logits_and_aux(self, params, batch, rng=None, is_training=True):
+        # rng threads into gate noise (noisy_gate_policy); shared across
+        # layers within a step (independent per micro-batch via the engine)
+        def mlp_fn(cfg, mlp_params, x):
+            return moe_forward(self.moe_cfg, mlp_params, x, rng=rng,
+                               is_training=is_training)
+        return forward(self.cfg, params, batch["input_ids"],
+                       positions=batch.get("positions"),
+                       attention_mask=batch.get("attention_mask"),
+                       mlp_fn=mlp_fn, return_aux=True)
+
+    def logits(self, params, batch, rng=None):
+        return self.logits_and_aux(params, batch, rng)[0]
+
+    def _loss(self, params, batch, rng, is_training):
+        logits, aux = self.logits_and_aux(params, batch, rng, is_training)
+        if "labels" in batch:
+            ce = cross_entropy_loss(logits, batch["labels"],
+                                    batch.get("attention_mask"))
+        else:
+            labels = batch["input_ids"][:, 1:]
+            mask = batch.get("attention_mask")
+            ce = cross_entropy_loss(logits[:, :-1], labels,
+                                    mask[:, 1:] if mask is not None else None)
+        return ce + aux.astype(ce.dtype)
+
+    def loss(self, params, batch, rng=None):
+        return self._loss(params, batch, rng, is_training=True)
+
+    def eval_loss(self, params, batch, rng=None):
+        """Eval path: eval_capacity_factor, no gate noise (the engine's
+        eval step prefers this method when present)."""
+        return self._loss(params, batch, None, is_training=False)

@@ -270,11 +270,16 @@ def _mlp_block(cfg: TransformerConfig, p, x):
 
 def _layer_body(cfg: TransformerConfig, layer_params, x, sin, cos, mask,
                 mlp_fn=None):
+    """Returns (x, aux) — aux is 0 for dense MLPs, the load-balancing loss
+    for MoE mlp_fns (accumulated through the layer scan)."""
     h = _norm_apply(cfg, layer_params["norm1"], x)
     x = x + _attention_block(cfg, layer_params["attn"], h, sin, cos, mask)
     h = _norm_apply(cfg, layer_params["norm2"], x)
     mlp_out = (mlp_fn or _mlp_block)(cfg, layer_params["mlp"], h)
-    return x + mlp_out
+    aux = jnp.zeros((), jnp.float32)
+    if isinstance(mlp_out, tuple):
+        mlp_out, aux = mlp_out
+    return x + mlp_out, aux
 
 
 _REMAT_POLICIES = {
@@ -289,8 +294,9 @@ _REMAT_POLICIES = {
 def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
             positions: Optional[jax.Array] = None,
             attention_mask: Optional[jax.Array] = None,
-            mlp_fn=None) -> jax.Array:
-    """Token ids [B,S] -> logits [B,S,V] (fp32)."""
+            mlp_fn=None, return_aux: bool = False) -> jax.Array:
+    """Token ids [B,S] -> logits [B,S,V] (fp32); with ``return_aux``,
+    returns (logits, accumulated MoE aux loss)."""
     params = meta.unbox(params) if _has_boxes(params) else params
     b, s = input_ids.shape
     if positions is None:
@@ -315,15 +321,18 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
     body = functools.partial(_layer_body, cfg, mlp_fn=mlp_fn) \
         if mlp_fn is not None else functools.partial(_layer_body, cfg)
 
+    aux_total = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:
         def scan_body(carry, layer_params):
-            y = body(layer_params, carry, sin, cos, mask)
-            return y, None
+            x, aux_acc = carry
+            y, aux = body(layer_params, x, sin, cos, mask)
+            return (y, aux_acc + aux), None
         if cfg.remat:
             policy = _REMAT_POLICIES[cfg.remat_policy]
             scan_body = jax.checkpoint(scan_body, policy=policy,
                                        prevent_cse=False)
-        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        (x, aux_total), _ = jax.lax.scan(scan_body, (x, aux_total),
+                                         params["layers"])
     else:
         for i in range(cfg.num_layers):
             lp = params["layers"][f"layer_{i}"]
@@ -331,7 +340,8 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
             if cfg.remat:
                 fn = jax.checkpoint(body, policy=_REMAT_POLICIES[cfg.remat_policy],
                                     prevent_cse=False)
-            x = fn(lp, x, sin, cos, mask)
+            x, aux = fn(lp, x, sin, cos, mask)
+            aux_total = aux_total + aux
 
     x = _norm_apply(cfg, params["final_norm"], x)
     if cfg.tie_embeddings:
@@ -339,7 +349,10 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
     else:
         logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(cfg.dtype))
     logits = _constrain(logits, BATCH, "seq", "tensor")
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def _has_boxes(params) -> bool:

@@ -1,0 +1,127 @@
+"""MoE gating (reference ``deepspeed/moe/sharded_moe.py``: ``TopKGate``
+:385, ``top1gating`` :188, ``top2gating`` :301, ``topkgating``, capacity
+:160, gumbel :80, aux loss) — re-derived for static XLA shapes.
+
+All shapes are static: capacity is computed at trace time from token count
+and capacity factor (optionally rounded up through *capacity bins*, the
+HabanaAI static-shape trick in ``moe/capacity_bins.py:14`` — on XLA this
+is what prevents recompilation as capacity fluctuates).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GateOutput(NamedTuple):
+    l_aux: jax.Array            # load-balancing auxiliary loss
+    combine_weights: jax.Array  # [T, E, C] float
+    dispatch_mask: jax.Array    # [T, E, C] bool
+    exp_counts: jax.Array       # [E] tokens routed per expert (pre-drop)
+
+
+def compute_capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+                     min_capacity: int = 4, top_k: int = 1,
+                     capacity_bins: Optional[list] = None) -> int:
+    """Static capacity (reference _capacity, sharded_moe.py:160)."""
+    cap = math.ceil(num_tokens * top_k / num_experts * capacity_factor)
+    cap = max(cap, min_capacity)
+    if capacity_bins:
+        for b in sorted(capacity_bins):
+            if cap <= b:
+                return b
+        return max(capacity_bins)
+    return cap
+
+
+def _one_hot(idx: jax.Array, n: int) -> jax.Array:
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def _gumbel_noise(rng, shape):
+    u = jax.random.uniform(rng, shape, minval=1e-9, maxval=1.0 - 1e-9)
+    return -jnp.log(-jnp.log(u))
+
+
+def topk_gating(logits: jax.Array,
+                k: int,
+                capacity_factor: float = 1.0,
+                min_capacity: int = 4,
+                drop_tokens: bool = True,
+                noisy_gate_policy: Optional[str] = None,
+                rng: Optional[jax.Array] = None,
+                capacity_bins: Optional[list] = None) -> GateOutput:
+    """General top-k gating with capacity dropping.
+
+    logits: [T, E].  Returns combine/dispatch tensors [T, E, C] (the GShard
+    formulation the reference einsums implement).
+    """
+    t, e = logits.shape
+    capacity = compute_capacity(t, e, capacity_factor, min_capacity, k,
+                                capacity_bins)
+    if not drop_tokens:
+        capacity = max(capacity, t)  # nothing can overflow
+
+    route_logits = logits
+    if noisy_gate_policy == "RSample" and rng is not None:
+        route_logits = logits + _gumbel_noise(rng, logits.shape)
+    elif noisy_gate_policy == "Jitter" and rng is not None:
+        route_logits = logits * jax.random.uniform(rng, logits.shape, minval=0.98,
+                                                   maxval=1.02)
+
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+
+    # iterative top-k with per-expert position assignment
+    masks = []
+    sel_gates = []
+    remaining = route_logits.astype(jnp.float32)
+    for i in range(k):
+        idx = jnp.argmax(remaining, axis=-1)          # [T]
+        mask = _one_hot(idx, e)                       # [T, E]
+        masks.append(mask)
+        sel_gates.append(jnp.sum(gates * mask, axis=-1))  # [T]
+        remaining = jnp.where(mask.astype(bool), -jnp.inf, remaining)
+
+    # aux loss from the top-1 assignment (reference top1gating l_aux)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    l_aux = jnp.sum(me * ce) * e
+
+    exp_counts = sum(masks).sum(axis=0).astype(jnp.int32)
+
+    # positions within each expert: cumulative across the k choices so a
+    # token's 2nd choice queues behind all 1st choices (reference top2:
+    # locations2 += sum(mask1))
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    dispatch = jnp.zeros((t, e, capacity), bool)
+    offset = jnp.zeros((e,), jnp.float32)
+    for i in range(k):
+        mask = masks[i]
+        pos = jnp.cumsum(mask, axis=0) - mask + offset[None, :]  # [T, E]
+        offset = offset + mask.sum(axis=0)
+        within = (pos < capacity) & mask.astype(bool)
+        pos_c = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+        sel = jnp.where(within, sel_gates[i][:, None], 0.0)      # [T, E]
+        oh = jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)  # [T, E, C]
+        combine = combine + sel[..., None] * oh * within[..., None]
+        dispatch = dispatch | (oh.astype(bool) & within[..., None])
+
+    if k > 1:
+        # renormalize over the selected experts (reference top2 denom)
+        denom = combine.sum(axis=(1, 2), keepdims=True)
+        combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9), 0.0)
+
+    return GateOutput(l_aux=l_aux, combine_weights=combine,
+                      dispatch_mask=dispatch, exp_counts=exp_counts)
+
+
+def top1_gating(logits, **kw) -> GateOutput:
+    return topk_gating(logits, k=1, **kw)
+
+
+def top2_gating(logits, **kw) -> GateOutput:
+    return topk_gating(logits, k=2, **kw)

@@ -436,12 +436,22 @@ class DeepSpeedEngine:
         return NamedSharding(mesh, P(*spec))
 
     def _place_batch(self, batch, microbatched: bool):
-        return jax.tree.map(
-            lambda x: jax.device_put(x, self._batch_leaf_sharding(x, microbatched)),
-            batch)
+        shards = self.topology.batch_shard_size
+
+        def place(x):
+            batch_dim = 1 if microbatched else 0
+            if np.ndim(x) > batch_dim and np.shape(x)[batch_dim] % shards != 0:
+                raise ValueError(
+                    f"batch dim {np.shape(x)[batch_dim]} not divisible by the "
+                    f"{shards} batch shards (mesh data x expert x fsdp); pad "
+                    f"the batch or adjust the mesh")
+            return jax.device_put(x, self._batch_leaf_sharding(x, microbatched))
+        return jax.tree.map(place, batch)
 
     def _build_eval_step(self):
-        loss_fn = self._loss_fn
+        # models may provide a dedicated eval path (e.g. MoE
+        # eval_capacity_factor / no gate noise)
+        loss_fn = getattr(self.module, "eval_loss", None) or self._loss_fn
         compute_dtype = self.compute_dtype
         partitioner = self.partitioner
         mesh = self.topology.mesh

@@ -1,0 +1,53 @@
+"""DeepSpeed-Ulysses sequence parallelism (reference
+``deepspeed/sequence/layer.py:65`` ``DistributedAttention``,
+``single_all_to_all`` :19, ``_SeqAllToAll`` :49).
+
+Two equivalent TPU paths:
+
+1. **Implicit (preferred)** — the transformer core annotates q/k/v with
+   head-sharded PartitionSpecs around attention and XLA inserts the two
+   all-to-alls (models/transformer.py).  Zero code at the call site.
+2. **Explicit (this module)** — a drop-in ``DistributedAttention`` wrapper
+   for use inside ``shard_map``, matching the reference's composition
+   contract: any local attention callable is sandwiched between
+   scatter-heads/gather-seq and the inverse.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+
+
+def seq_all_to_all(x: jax.Array, axis_name: str, scatter_axis: int,
+                   gather_axis: int) -> jax.Array:
+    """reference single_all_to_all (sequence/layer.py:19): redistribute a
+    [.., seq_local, heads, ..] tensor to [.., seq, heads_local, ..]."""
+    return lax.all_to_all(x, axis_name, split_axis=scatter_axis,
+                          concat_axis=gather_axis, tiled=True)
+
+
+class DistributedAttention:
+    """Ulysses sandwich (reference DistributedAttention, sequence/layer.py:65).
+
+    ``local_attention(q, k, v, *args)`` sees the FULL sequence and a 1/P
+    head slice; call inside shard_map with the 'seq' axis in scope.
+    Layout: [B, S_local, H, D] in, [B, S_local, H, D] out.
+    """
+
+    def __init__(self, local_attention: Callable, axis_name: str = "seq",
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.axis_name = axis_name
+        self.scatter_idx = scatter_idx  # heads dim
+        self.gather_idx = gather_idx    # sequence dim
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        q = seq_all_to_all(query, self.axis_name, self.scatter_idx, self.gather_idx)
+        k = seq_all_to_all(key, self.axis_name, self.scatter_idx, self.gather_idx)
+        v = seq_all_to_all(value, self.axis_name, self.scatter_idx, self.gather_idx)
+        ctx = self.local_attn(q, k, v, *args, **kwargs)
+        # inverse: scatter seq back, gather heads
+        return seq_all_to_all(ctx, self.axis_name, self.gather_idx, self.scatter_idx)

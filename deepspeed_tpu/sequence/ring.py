@@ -1,0 +1,91 @@
+"""Ring attention — context parallelism over the 'seq' mesh axis.
+
+The reference has NO context-parallel path (SURVEY.md §2.3: Ulysses
+all-to-all is its only long-context mechanism); this is the TPU-idiomatic
+extension: blockwise attention with flash-style running statistics while
+K/V blocks circulate the ring via ``lax.ppermute`` over ICI.  Communication
+is overlapped with the per-block attention compute by XLA's scheduler;
+memory per device stays O(S/P).
+
+Causal variant skips fully-masked blocks' *contribution* (they still
+travel the ring — the permute is the pipeline) via position masking.
+
+Use inside ``shard_map`` with q/k/v sharded [B, H, S/P, D] on 'seq'.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One q-block x kv-block partial attention.  Returns (m, l, acc)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    s = jnp.where(mask, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)                       # [B,H,Sq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m, l, acc
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "seq",
+                   causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """q, k, v: [B, H, S_local, D] inside shard_map over ``axis_name``."""
+    p = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(d)
+
+    q_pos = r * s_local + lax.broadcasted_iota(jnp.int32, (s_local, 1), 0)
+
+    def step(i, carry):
+        m_run, l_run, acc_run, kv_k, kv_v = carry
+        src = (r - i) % p  # whose block we currently hold
+        k_pos = src * s_local + lax.broadcasted_iota(jnp.int32, (1, s_local), 1)
+        mask = (q_pos >= k_pos) if causal else jnp.ones((s_local, s_local), bool)
+        mask = mask[None, None]
+        m_blk, l_blk, acc_blk = _block_attn(q, kv_k, kv_v, scale, mask)
+
+        m_new = jnp.maximum(m_run, m_blk)
+        alpha = jnp.exp(m_run - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l_new = l_run * alpha + l_blk * beta
+        acc_new = acc_run * alpha + acc_blk * beta
+
+        # rotate K/V for the next step; the last iteration's rotation is
+        # skipped (its result would be discarded)
+        def rotate(kv):
+            kk, vv = kv
+            perm = [(j, (j + 1) % p) for j in range(p)]
+            return lax.ppermute(kk, axis_name, perm), \
+                lax.ppermute(vv, axis_name, perm)
+        kv_k, kv_v = lax.cond(i < p - 1, rotate, lambda kv: kv, (kv_k, kv_v))
+        return m_new, l_new, acc_new, kv_k, kv_v
+
+    m0 = jnp.full((b, h, s_local, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    m, l, acc, _, _ = lax.fori_loop(0, p, step, (m0, l0, acc0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, causal: bool = True):
+    """Convenience wrapper: q,k,v [B,H,S,D] globally, seq-sharded on 'seq'."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    spec = P(None, None, "seq", None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name="seq", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
