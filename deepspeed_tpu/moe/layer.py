@@ -78,19 +78,14 @@ def init_moe_params(cfg: MoEConfig, hidden: int, ffn: int, rng: jax.Array,
     return p
 
 
-def _constrain(x, *spec):
-    try:
-        return jax.lax.with_sharding_constraint(x, P(*spec))
-    except (ValueError, RuntimeError):
-        return x
+# shared with the dense transformer core (one source of truth for the
+# activation dispatch and the mesh-context-degrading sharding constraint)
+from ..models.transformer import _constrain
 
 
 def _expert_act(cfg: MoEConfig, gate, up):
-    if cfg.activation == "silu_gated":
-        return jax.nn.silu(gate) * up
-    if cfg.activation == "gelu_gated":
-        return jax.nn.gelu(gate) * up
-    return jax.nn.gelu(up)
+    from ..models.transformer import _activation
+    return _activation(cfg, gate if "gated" in cfg.activation else None, up)
 
 
 def moe_forward(cfg: MoEConfig, params, x: jax.Array,

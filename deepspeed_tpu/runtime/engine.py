@@ -336,6 +336,10 @@ class DeepSpeedEngine:
                 lambda x, s: jax.lax.with_sharding_constraint(x, NamedSharding(mesh, s)),
                 tree, specs)
 
+        # Pipeline mode: the loss_fn consumes the whole [gas, micro, ...]
+        # batch in one pipelined evaluation (no outer micro-batch scan).
+        fused_mb = getattr(self, "_fused_microbatches", False)
+
         def step_fn(state: TrainState, batch, rng):
             # ZeRO: compute params = cast(master) re-sharded to param layout.
             # stage>=1: this IS the post-step allgather of bf16 weights —
@@ -358,12 +362,21 @@ class DeepSpeedEngine:
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params_c)
             rngs = jax.random.split(rng, gas)
-            if gas == 1:
+            if fused_mb:
+                # loss is already a mean over every micro-batch token
+                def scaled_loss(p):
+                    l = loss_fn(p, batch, rngs[0])
+                    return (l * state.loss_scale).astype(jnp.float32)
+                loss, grads = jax.value_and_grad(scaled_loss)(params_c)
+                grads = constrain(
+                    jax.tree.map(lambda g: g.astype(jnp.float32), grads), gspecs)
+                losses = (loss / state.loss_scale)[None]
+            elif gas == 1:
                 grads, losses = micro(zero_grads, (jax.tree.map(lambda x: x[0], batch), rngs[0]))
                 losses = losses[None]
             else:
                 grads, losses = jax.lax.scan(micro, zero_grads, (batch, rngs))
-            inv = 1.0 / (gas * state.loss_scale)
+            inv = 1.0 / ((1 if fused_mb else gas) * state.loss_scale)
             grads = jax.tree.map(lambda g: g * inv, grads)
 
             # global grad norm (over ALL shards; XLA handles cross-device sum)

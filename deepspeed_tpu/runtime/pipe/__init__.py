@@ -1,0 +1,18 @@
+from .module import LayerSpec, PipelineModule, TiedLayerSpec
+from .schedule import (BackwardPass, DataParallelSchedule, ForwardPass,
+                       InferenceSchedule, LoadMicroBatch, OptimizerStep,
+                       PipeInstruction, PipeSchedule, RecvActivation,
+                       RecvGrad, ReduceGrads, ReduceTiedGrads,
+                       SendActivation, SendGrad, TrainSchedule)
+from .engine import (PipelineEngine, PipelinedCausalLM, PipelinedModule,
+                     gpipe_spmd, stack_stages)
+
+__all__ = [
+    "LayerSpec", "TiedLayerSpec", "PipelineModule",
+    "PipeSchedule", "TrainSchedule", "InferenceSchedule",
+    "DataParallelSchedule", "PipeInstruction", "OptimizerStep",
+    "ReduceGrads", "ReduceTiedGrads", "LoadMicroBatch", "ForwardPass",
+    "BackwardPass", "SendActivation", "RecvActivation", "SendGrad",
+    "RecvGrad", "PipelineEngine", "PipelinedCausalLM", "PipelinedModule",
+    "gpipe_spmd", "stack_stages",
+]

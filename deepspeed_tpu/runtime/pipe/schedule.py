@@ -1,0 +1,250 @@
+"""Pipeline schedules (reference ``runtime/pipe/schedule.py``).
+
+The reference defines an instruction ISA (schedule.py:327-…: ``OptimizerStep,
+ReduceGrads, ReduceTiedGrads, LoadMicroBatch, ForwardPass, BackwardPass,
+SendActivation, RecvActivation, SendGrad, RecvGrad``) and per-rank
+generators: ``TrainSchedule`` (1F1B, :189), ``InferenceSchedule`` (:135),
+``DataParallelSchedule``.  ``PipelineEngine._exec_schedule`` walks the
+instruction stream.
+
+On TPU the *executor* is different: the whole pipeline is one XLA program
+(`engine.py` here lowers the microbatch loop to ``lax.scan`` +
+``ppermute``), so the per-instruction host dispatch of the reference
+disappears.  The ISA is still the right description level for
+
+  * schedule correctness reasoning/tests (1F1B invariants),
+  * the host-driven executor fallback (debugging, heterogeneous stages),
+  * tooling parity (anything that introspects schedules).
+
+Semantics match the reference: ``micro_batches`` buffers flow through
+``stages`` pipeline stages; a schedule yields, per "clock step", the list
+of instructions one ``stage_id`` executes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """Base instruction (reference schedule.py:327)."""
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    """Apply the optimizer update (reference schedule.py:338)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction (reference schedule.py:346)."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """All-reduce tied-weight grads across their tie group (reference
+    schedule.py:353; module.py:440 allreduce_tied_weight_gradients)."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    """Instruction operating on a pipeline buffer slot (schedule.py:363)."""
+
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    """Load micro-batch ``micro_batch_id`` into ``buffer_id``."""
+
+    def __init__(self, buffer_id: int, micro_batch_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, micro_batch_id=micro_batch_id,
+                         **kwargs)
+
+
+class ForwardPass(BufferOpInstruction):
+    """Run the stage forward on buffer ``buffer_id``."""
+
+    def __init__(self, buffer_id: int, micro_batch_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, micro_batch_id=micro_batch_id,
+                         **kwargs)
+
+
+class BackwardPass(BufferOpInstruction):
+    """Run the stage backward on buffer ``buffer_id``."""
+
+    def __init__(self, buffer_id: int, micro_batch_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, micro_batch_id=micro_batch_id,
+                         **kwargs)
+
+
+class SendActivation(BufferOpInstruction):
+    """p2p activation send to stage+1 (collective-permute on TPU)."""
+
+
+class RecvActivation(BufferOpInstruction):
+    """p2p activation recv from stage-1."""
+
+
+class SendGrad(BufferOpInstruction):
+    """p2p activation-grad send to stage-1."""
+
+
+class RecvGrad(BufferOpInstruction):
+    """p2p activation-grad recv from stage+1."""
+
+
+class PipeSchedule:
+    """Per-stage instruction-stream generator (reference schedule.py:22).
+
+    Subclasses implement ``steps()`` yielding ``List[PipeInstruction]`` per
+    clock step for this ``stage_id``.
+    """
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        if not (0 <= stage_id < stages):
+            raise ValueError(f"stage_id {stage_id} out of range for {stages}")
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    # -- topology helpers (reference schedule.py:66-101) -------------------
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def num_pipe_buffers(self) -> int:
+        """Buffer slots this stage needs (reference schedule.py:102)."""
+        return self.micro_batches
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelining (reference schedule.py:135): at clock step t,
+    stage s forwards micro-batch t - s (when valid)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            buf = micro_batch_id % self.num_pipe_buffers() \
+                if micro_batch_id >= 0 else 0
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buf, micro_batch_id))
+                else:
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf, micro_batch_id))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference schedule.py:189).
+
+    Phases per stage s (S stages, M micro-batches):
+      warmup   : min(M, S - s) forwards
+      steady   : alternate 1 backward / 1 forward
+      cooldown : remaining backwards
+      tail     : ReduceTiedGrads, ReduceGrads, OptimizerStep
+
+    In-flight forwards never exceed S - s, which bounds activation
+    memory — the property the XLA executor preserves via rematerialized
+    stage bodies.
+    """
+
+    def num_pipe_buffers(self) -> int:
+        # reference schedule.py:247: enough buffers for in-flight microbatches
+        return max(2, min(self.micro_batches, self.stages - self.stage_id))
+
+    def steps(self):
+        M, S, s = self.micro_batches, self.stages, self.stage_id
+        warmup = min(M, S - s)
+        fwd_id = 0   # next micro-batch to forward
+        bwd_id = 0   # next micro-batch to backward
+
+        # warmup forwards
+        for _ in range(warmup):
+            yield self._forward_cmds(fwd_id)
+            fwd_id += 1
+        # steady state: 1B1F
+        while fwd_id < M:
+            yield self._backward_cmds(bwd_id)
+            bwd_id += 1
+            yield self._forward_cmds(fwd_id)
+            fwd_id += 1
+        # cooldown backwards
+        while bwd_id < M:
+            yield self._backward_cmds(bwd_id)
+            bwd_id += 1
+        # gradient reduction + step (reference schedule.py:222-244 tail)
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+    def _forward_cmds(self, micro_batch_id: int) -> List[PipeInstruction]:
+        buf = self._buffer_idx(micro_batch_id)
+        cmds: List[PipeInstruction] = []
+        if self.is_first_stage:
+            cmds.append(LoadMicroBatch(buf, micro_batch_id))
+        else:
+            cmds.append(RecvActivation(buf))
+        cmds.append(ForwardPass(buf, micro_batch_id))
+        if not self.is_last_stage:
+            cmds.append(SendActivation(buf))
+        return cmds
+
+    def _backward_cmds(self, micro_batch_id: int) -> List[PipeInstruction]:
+        buf = self._buffer_idx(micro_batch_id)
+        cmds: List[PipeInstruction] = []
+        if not self.is_last_stage:
+            cmds.append(RecvGrad(buf))
+        cmds.append(BackwardPass(buf, micro_batch_id))
+        if not self.is_first_stage:
+            cmds.append(SendGrad(buf))
+        return cmds
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate no-pipeline schedule (reference schedule.py:305): forward+
+    backward every micro-batch, then reduce + step."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            yield [LoadMicroBatch(0, mb), ForwardPass(0, mb),
+                   BackwardPass(0, mb)]
+        yield [ReduceGrads(), OptimizerStep()]

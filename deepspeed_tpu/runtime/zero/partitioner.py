@@ -124,6 +124,7 @@ def default_sharding_rules(topology: MeshTopology, zero_stage: int) -> dict:
         "qkv": tp,
         "expert": "expert" if topology.ep_world_size > 1 else None,
         "layers": None,         # scan-over-layers axis never sharded
+        "stages": "pipe" if topology.pp_world_size > 1 else None,
         "norm": None,
     }
     return rules
