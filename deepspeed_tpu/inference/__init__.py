@@ -1,0 +1,8 @@
+"""Inference stacks.
+
+``v2`` is the FastGen-equivalent ragged continuous-batching engine
+(reference ``deepspeed/inference/v2/``); the v1 engine
+(``init_inference`` module-injection path) lives in ``engine_v1``.
+"""
+
+from . import v2  # noqa: F401

@@ -1,0 +1,16 @@
+from .config import (KVCacheUserConfig, RaggedInferenceEngineConfig,
+                     StateManagerConfig)
+from .engine import InferenceEngineV2, SchedulingError, SchedulingResult
+from .model import RaggedInferenceModel
+from .ragged import (BlockedAllocator, BlockedKVCache, KVCacheConfig,
+                     RaggedBatch, StateManager, build_batch)
+from .sampling import SamplingParams, sample
+from .scheduler import FastGenScheduler, Request, generate
+
+__all__ = [
+    "KVCacheUserConfig", "RaggedInferenceEngineConfig", "StateManagerConfig",
+    "InferenceEngineV2", "SchedulingError", "SchedulingResult",
+    "RaggedInferenceModel", "BlockedAllocator", "BlockedKVCache",
+    "KVCacheConfig", "RaggedBatch", "StateManager", "build_batch",
+    "SamplingParams", "sample", "FastGenScheduler", "Request", "generate",
+]

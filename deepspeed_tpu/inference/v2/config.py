@@ -1,0 +1,50 @@
+"""Inference-v2 engine configuration.
+
+Reference: ``inference/v2/config_v2.py`` (``RaggedInferenceEngineConfig``
+with nested state-manager / KV-cache / tensor-parallel pydantic models).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class StateManagerConfig:
+    max_tracked_sequences: int = 2048
+    max_ragged_sequence_count: int = 512
+    max_ragged_batch_size: int = 768       # token budget per forward
+    memory_fraction: float = 0.8           # of free HBM, for the KV cache
+
+
+@dataclasses.dataclass
+class KVCacheUserConfig:
+    page_size: int = 64
+    num_pages: Optional[int] = None        # None -> sized from memory_fraction
+    dtype: Any = jnp.bfloat16
+
+
+@dataclasses.dataclass
+class RaggedInferenceEngineConfig:
+    state_manager: StateManagerConfig = dataclasses.field(
+        default_factory=StateManagerConfig)
+    kv_cache: KVCacheUserConfig = dataclasses.field(
+        default_factory=KVCacheUserConfig)
+    tp_size: int = 1
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RaggedInferenceEngineConfig":
+        cfg = cls()
+        sm = d.get("state_manager", {})
+        for k, v in sm.items():
+            if hasattr(cfg.state_manager, k):
+                setattr(cfg.state_manager, k, v)
+        kv = d.get("kv_cache", {})
+        for k, v in kv.items():
+            if hasattr(cfg.kv_cache, k):
+                setattr(cfg.kv_cache, k, v)
+        cfg.tp_size = d.get("tensor_parallel", {}).get("tp_size", 1)
+        return cfg

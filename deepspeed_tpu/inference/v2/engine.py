@@ -1,0 +1,199 @@
+"""InferenceEngineV2 — ragged continuous-batching inference engine.
+
+Reference contract: ``inference/v2/engine_v2.py:30`` —
+``put(uids, tokens)`` runs ONE ragged forward returning last-token
+logits per sequence; ``query``/``can_schedule`` expose KV/token
+occupancy to the scheduler; ``flush(uid)`` frees sequence state.
+
+TPU deltas: the forward is internally *grouped by Q-bucket* — a mixed
+put() of prefill chunks and decode tokens runs one compiled program per
+bucket (decode Q=1 compiles once and is allocation-free via KV
+donation), rather than one CUDA megakernel over a flat token array.
+Logits rows are re-assembled in uid order, so callers see the reference
+semantics exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .config import RaggedInferenceEngineConfig
+from .model import RaggedInferenceModel
+from .ragged import (KVCacheConfig, StateManager, build_batch,
+                     pages_for_memory, placeholder)
+
+
+class SchedulingResult(enum.Enum):
+    Success = 0
+    EngineSequenceLimitExceeded = 1
+    BatchSequenceLimitExceeded = 2
+    BatchTokenLimitExceeded = 3
+    KVCacheLimitExceeded = 4
+
+
+class SchedulingError(RuntimeError):
+    def __init__(self, result: SchedulingResult):
+        super().__init__(f"cannot schedule batch: {result.name}")
+        self.result = result
+
+
+class InferenceEngineV2:
+    def __init__(self, model: RaggedInferenceModel,
+                 config: Optional[RaggedInferenceEngineConfig] = None):
+        self._config = config or RaggedInferenceEngineConfig()
+        self._model = model
+        kv_user = self._config.kv_cache
+        if not model.kv_config_explicit:
+            # user config wins over the model's default cache geometry;
+            # num_pages=None is sized from free-memory fraction (reference
+            # sizes its blocked KV pool the same way)
+            kv_cfg = KVCacheConfig(
+                num_layers=model.kv_config.num_layers,
+                kv_heads=model.kv_config.kv_heads,
+                head_dim=model.kv_config.head_dim,
+                page_size=kv_user.page_size,
+                num_pages=kv_user.num_pages or 1, dtype=kv_user.dtype)
+            if kv_user.num_pages is None:
+                budget = self._free_device_memory()
+                if budget is not None:
+                    budget = int(
+                        budget * self._config.state_manager.memory_fraction)
+                    kv_cfg = dataclasses.replace(
+                        kv_cfg, num_pages=pages_for_memory(kv_cfg, budget))
+                else:
+                    kv_cfg = dataclasses.replace(
+                        kv_cfg, num_pages=model.kv_config.num_pages)
+            model.kv_config = kv_cfg
+        else:
+            kv_cfg = model.kv_config
+        self._state = StateManager(
+            kv_cfg,
+            max_tracked_sequences=self._config.state_manager.max_tracked_sequences,
+            kv_sharding=model.kv_sharding())
+
+    @staticmethod
+    def _free_device_memory() -> Optional[int]:
+        """Free HBM on device 0, or None when the backend doesn't report
+        memory stats (CPU/CI)."""
+        try:
+            stats = jax.devices()[0].memory_stats()
+            if stats and "bytes_limit" in stats:
+                return stats["bytes_limit"] - stats.get("bytes_in_use", 0)
+        except Exception:
+            pass
+        return None
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return self._state.free_pages
+
+    @property
+    def model(self) -> RaggedInferenceModel:
+        return self._model
+
+    @property
+    def state_manager(self) -> StateManager:
+        return self._state
+
+    def seen_tokens(self, uid: int) -> int:
+        sd = self._state.get_sequence(uid)
+        return sd.seen_tokens if sd is not None else 0
+
+    # -- scheduling queries --------------------------------------------------
+    def query(self, uid: int, max_request_tokens: int,
+              max_request_blocks: int) -> Tuple[int, int]:
+        sd = self._state.get_sequence(uid)
+        if sd is None:
+            if (self._state.n_tracked_sequences
+                    >= self._config.state_manager.max_tracked_sequences):
+                return (0, 0)
+            sd = placeholder()
+        return self._model.get_kv_requirements(
+            sd.seen_tokens, sd.allocated_capacity,
+            max_request_tokens, max_request_blocks)
+
+    def get_remaining_block_capacity(self, uid: int) -> int:
+        sd = self._state.get_sequence(uid)
+        if sd is None:
+            return 0
+        page = self._model.kv_config.page_size
+        return sd.allocated_capacity * page - sd.seen_tokens
+
+    def can_schedule(self, uids: Sequence[int],
+                     lengths: Sequence[int]) -> SchedulingResult:
+        sm_cfg = self._config.state_manager
+        if len(uids) > sm_cfg.max_ragged_sequence_count:
+            return SchedulingResult.BatchSequenceLimitExceeded
+        cur_seqs = self._state.n_tracked_sequences
+        free = self._state.free_pages
+        batch_tokens = 0
+        for uid, length in zip(uids, lengths):
+            sd = self._state.get_sequence(uid)
+            if sd is None:
+                cur_seqs += 1
+                sd = placeholder()
+            tokens, pages = self._model.get_kv_requirements(
+                sd.seen_tokens, sd.allocated_capacity, length, free)
+            if tokens != length:
+                return SchedulingResult.KVCacheLimitExceeded
+            batch_tokens += length
+            free -= pages
+        if cur_seqs > sm_cfg.max_tracked_sequences:
+            return SchedulingResult.EngineSequenceLimitExceeded
+        if batch_tokens > sm_cfg.max_ragged_batch_size:
+            return SchedulingResult.BatchTokenLimitExceeded
+        return SchedulingResult.Success
+
+    # -- the forward ---------------------------------------------------------
+    def put(self, batch_uids: Sequence[int],
+            batch_tokens: Sequence[np.ndarray],
+            do_checks: bool = True) -> jax.Array:
+        """One ragged forward; returns logits [len(batch_uids), V] in
+        input order."""
+        if do_checks:
+            res = self.can_schedule(batch_uids,
+                                    [len(t) for t in batch_tokens])
+            if res != SchedulingResult.Success:
+                raise SchedulingError(res)
+
+        descs = []
+        for uid, toks in zip(batch_uids, batch_tokens):
+            sd = self._state.get_or_create_sequence(uid)
+            self._state.allocate_for(sd, len(toks))
+            sd.pre_forward(len(toks))
+            descs.append(sd)
+
+        # group by Q bucket: decode (len==1) and prefill groups compile
+        # separately so decodes never pad to prefill width.
+        groups: Dict[int, List[int]] = {}
+        for i, toks in enumerate(batch_tokens):
+            q = 1
+            while q < len(toks):
+                q *= 2
+            groups.setdefault(q, []).append(i)
+
+        logits_rows: List[Optional[jax.Array]] = [None] * len(batch_uids)
+        for q_bucket in sorted(groups):
+            idxs = groups[q_bucket]
+            sub_descs = [descs[i] for i in idxs]
+            sub_tokens = [np.asarray(batch_tokens[i]) for i in idxs]
+            batch = build_batch(sub_descs, sub_tokens,
+                                self._model.kv_config.page_size)
+            logits, self._state.kv_cache.data = self._model.forward(
+                batch, self._state.kv_cache.data)
+            for row, i in enumerate(idxs):
+                logits_rows[i] = logits[row]
+
+        for sd in descs:
+            sd.post_forward()
+        import jax.numpy as jnp
+        return jnp.stack(logits_rows)
+
+    def flush(self, uid: int) -> None:
+        self._state.flush_sequence(uid)

@@ -1,0 +1,183 @@
+"""Ragged inference model over the shared transformer core.
+
+Reference: ``inference/v2/model_implementations/inference_transformer_base.py``
+(``DSTransformerModelBase``) + per-arch models (llama_v2/model.py:22,
+mistral, mixtral, …).  There, a from-scratch module layer re-implements
+every op class against CUDA kernels.  Here the *training* transformer
+core (models/transformer.py) is reused: the same params, norms and
+projections, with attention swapped for the paged ragged formulation
+(ops/paged_attention.py) and the layer scan threading KV pages through.
+
+Every distinct batch bucket shape ``(S, Q, P)`` compiles exactly once;
+the KV cache is donated so decoding is allocation-free on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...models import transformer as T
+from ...ops.paged_attention import (gather_last, paged_attention,
+                                    token_positions, write_kv)
+from .ragged import KVCacheConfig, RaggedBatch
+
+
+class RaggedInferenceModel:
+    """Stateless compiled step over (params, kv, batch arrays)."""
+
+    def __init__(self, cfg: T.TransformerConfig, params: Any,
+                 kv_config: Optional[KVCacheConfig] = None,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 mlp_fn: Optional[Callable] = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.mlp_fn = mlp_fn
+        self.kv_config_explicit = kv_config is not None
+        self.kv_config = kv_config or KVCacheConfig(
+            num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
+            head_dim=cfg.dims_per_head, dtype=cfg.dtype)
+        if mesh is not None and T._has_boxes(params):
+            # TP sharding: heads/ffn/vocab over the 'tensor' mesh axis (the
+            # AutoTP analogue — reference module_inject/auto_tp.py slices
+            # Linears row/col; GSPMD derives the same split + collectives
+            # from these specs).  Logical axes come from the Partitioned
+            # boxes the model init attached.
+            from ...runtime.zero.partitioner import logical_to_mesh_spec
+            rules = {"heads": "tensor", "kv": "tensor", "mlp": "tensor",
+                     "vocab": "tensor", "expert": "expert"}
+
+            def _shard(leaf):
+                if isinstance(leaf, T.meta.Partitioned):
+                    spec = logical_to_mesh_spec(tuple(leaf.names), rules)
+                    # drop axes that don't divide the dim (reference AutoTP
+                    # keeps indivisible modules unsharded)
+                    entries = []
+                    for i, entry in enumerate(spec):
+                        size = mesh.shape.get(entry, 1) if entry else 1
+                        ok = entry and leaf.value.shape[i] % size == 0
+                        entries.append(entry if ok else None)
+                    return jax.device_put(
+                        leaf.value,
+                        jax.sharding.NamedSharding(mesh, P(*entries)))
+                return jax.device_put(
+                    leaf, jax.sharding.NamedSharding(mesh, P()))
+
+            params = jax.tree.map(
+                _shard, params,
+                is_leaf=lambda x: isinstance(x, T.meta.Partitioned))
+        else:
+            params = T.meta.unbox(params) if T._has_boxes(params) else params
+        self.params = params
+        self._step_cache: Dict[Tuple[int, int, int], Callable] = {}
+
+    # -- sharding of the KV cache ------------------------------------------
+    def kv_sharding(self) -> Optional[jax.sharding.Sharding]:
+        if self.mesh is None:
+            return None
+        # [L, pages, page, 2, K, D]: shard kv heads over 'tensor'
+        if self.kv_config.kv_heads % max(
+                self.mesh.shape.get("tensor", 1), 1) == 0:
+            return jax.sharding.NamedSharding(
+                self.mesh, P(None, None, None, None, "tensor", None))
+        return jax.sharding.NamedSharding(self.mesh, P())
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, batch: RaggedBatch, kv: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+        """Run one ragged forward; returns (logits [S_live, V], new kv)."""
+        step = self._get_step(batch.shape_key)
+        logits, kv = step(self.params, kv, batch.token_ids, batch.q_lens,
+                          batch.start_pos, batch.page_table)
+        return logits, kv
+
+    def _get_step(self, key: Tuple[int, int, int]) -> Callable:
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._step_impl, donate_argnums=(1,))
+            self._step_cache[key] = fn
+        return fn
+
+    def _step_impl(self, params, kv, token_ids, q_lens, start_pos,
+                   page_table):
+        cfg = self.cfg
+        S, Q = token_ids.shape
+        x = params["embed"]["tokens"].astype(cfg.dtype)[token_ids]
+        pos = token_positions(start_pos, Q)
+        if cfg.pos_emb == "learned":
+            safe = jnp.minimum(pos, cfg.max_seq_len - 1)
+            x = x + params["embed"]["positions"].astype(cfg.dtype)[safe]
+        sin, cos = (T.rope_table(cfg, pos) if cfg.pos_emb == "rope"
+                    else (None, None))
+
+        body = functools.partial(self._layer_body, pos=pos, sin=sin, cos=cos,
+                                 q_lens=q_lens, start_pos=start_pos,
+                                 page_table=page_table)
+        if cfg.scan_layers:
+            x, kv = jax.lax.scan(
+                lambda carry, xs: (body(carry, xs[0], xs[1])),
+                x, (params["layers"], kv))
+        else:
+            kv_layers = []
+            for i in range(cfg.num_layers):
+                x, kv_i = body(x, params["layers"][f"layer_{i}"], kv[i])
+                kv_layers.append(kv_i)
+            kv = jnp.stack(kv_layers)
+
+        x = T._norm_apply(cfg, params["final_norm"], x)
+        last = gather_last(x, q_lens)                       # [S, E]
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("se,ve->sv", last,
+                                params["embed"]["tokens"].astype(cfg.dtype))
+        else:
+            logits = jnp.einsum("se,ev->sv", last,
+                                params["lm_head"].astype(cfg.dtype))
+        return logits.astype(jnp.float32), kv
+
+    def _layer_body(self, x, lp, kv_layer, *, pos, sin, cos, q_lens,
+                    start_pos, page_table):
+        cfg = self.cfg
+        dtype = cfg.dtype
+        h = T._norm_apply(cfg, lp["norm1"], x)
+        ap = lp["attn"]
+        q = jnp.einsum("sqe,ehd->sqhd", h, ap["wq"].astype(dtype))
+        k = jnp.einsum("sqe,ekd->sqkd", h, ap["wk"].astype(dtype))
+        v = jnp.einsum("sqe,ekd->sqkd", h, ap["wv"].astype(dtype))
+        if cfg.use_bias:
+            q = q + ap["bq"].astype(dtype)
+            k = k + ap["bk"].astype(dtype)
+            v = v + ap["bv"].astype(dtype)
+        if cfg.pos_emb == "rope":
+            q = T.apply_rope(q, sin, cos)
+            k = T.apply_rope(k, sin, cos)
+        kv_layer = write_kv(kv_layer, k, v, page_table, start_pos, q_lens)
+        attn = paged_attention(q, kv_layer, page_table, start_pos, q_lens)
+        out = jnp.einsum("sqhd,hde->sqe", attn, ap["wo"].astype(dtype))
+        if cfg.use_bias:
+            out = out + ap["bo"].astype(dtype)
+        x = x + out.astype(x.dtype)
+        h = T._norm_apply(cfg, lp["norm2"], x)
+        mlp_out = (self.mlp_fn or T._mlp_block)(cfg, lp["mlp"], h)
+        if isinstance(mlp_out, tuple):                      # MoE aux dropped
+            mlp_out = mlp_out[0]
+        return x + mlp_out.astype(x.dtype), kv_layer
+
+    # -- KV requirements (engine contract) ----------------------------------
+    def get_kv_requirements(self, seen_tokens: int, allocated_pages: int,
+                            max_new_tokens: int, max_new_pages: int
+                            ) -> Tuple[int, int]:
+        """(tokens schedulable, pages needed) given page headroom —
+        reference ``DSTransformerModelBase.get_kv_requirements``."""
+        page = self.kv_config.page_size
+        capacity = allocated_pages * page - seen_tokens
+        if max_new_tokens <= capacity:
+            return max_new_tokens, 0
+        need = -(-(max_new_tokens - capacity) // page)
+        if need <= max_new_pages:
+            return max_new_tokens, need
+        tokens = capacity + max_new_pages * page
+        return max(tokens, 0), max_new_pages

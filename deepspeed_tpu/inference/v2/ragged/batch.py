@@ -1,0 +1,91 @@
+"""Ragged batch — host-side builder producing static-shape device arrays.
+
+Reference: ``inference/v2/ragged/ragged_wrapper.py`` (``RaggedBatchWrapper``
+packs token ids + per-token/per-seq metadata into pinned host buffers
+mirrored on device).  Under XLA there is no pinned-buffer mirroring;
+instead the batch is padded into one of a small set of **static shape
+buckets** so every distinct shape compiles exactly once:
+
+    token_ids   : [S, Q] int32   (null-padded)
+    q_lens      : [S]    int32   new tokens per slot (0 = empty slot)
+    start_pos   : [S]    int32   committed history length per slot
+    page_table  : [S, P] int32   KV page indices (0 = null page)
+
+``S`` (sequence slots), ``Q`` (max new tokens per sequence) and ``P``
+(max pages per sequence) are bucketed powers of two; a pure-decode batch
+compiles with Q=1, a prefill chunk with Q=chunk.  Padding slots write
+their KV into the null page and are masked out of attention and logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .sequence import SequenceDescriptor
+
+
+def _bucket(n: int, floor: int = 1) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class RaggedBatch:
+    token_ids: np.ndarray    # [S, Q] int32
+    q_lens: np.ndarray       # [S] int32
+    start_pos: np.ndarray    # [S] int32
+    page_table: np.ndarray   # [S, P] int32
+    uids: List[int]          # live uids, in slot order (len <= S)
+
+    @property
+    def num_slots(self) -> int:
+        return self.token_ids.shape[0]
+
+    @property
+    def max_q(self) -> int:
+        return self.token_ids.shape[1]
+
+    @property
+    def current_sequences(self) -> int:
+        return len(self.uids)
+
+    @property
+    def shape_key(self) -> Tuple[int, int, int]:
+        return (self.token_ids.shape[0], self.token_ids.shape[1],
+                self.page_table.shape[1])
+
+
+def build_batch(seqs: Sequence[SequenceDescriptor],
+                tokens: Sequence[np.ndarray],
+                page_size: int,
+                min_slots: int = 1,
+                min_pages: int = 8) -> RaggedBatch:
+    """Pack (descriptor, new-token) pairs into a bucketed RaggedBatch.
+
+    Callers must already have reserved KV pages on each descriptor
+    (engine's ``maybe_allocate_kv``) and called ``pre_forward``.
+    """
+    n = len(seqs)
+    assert n == len(tokens) and n >= 1
+    S = _bucket(n, min_slots)
+    Q = _bucket(max(len(t) for t in tokens))
+    P = _bucket(max(max(s.allocated_capacity for s in seqs), 1), min_pages)
+
+    token_ids = np.zeros((S, Q), dtype=np.int32)
+    q_lens = np.zeros(S, dtype=np.int32)
+    start_pos = np.zeros(S, dtype=np.int32)
+    page_table = np.zeros((S, P), dtype=np.int32)
+    uids = []
+    for i, (sd, toks) in enumerate(zip(seqs, tokens)):
+        toks = np.asarray(toks, dtype=np.int32).reshape(-1)
+        token_ids[i, :len(toks)] = toks
+        q_lens[i] = len(toks)
+        start_pos[i] = sd.seen_tokens
+        page_table[i] = sd.page_table(P)
+        uids.append(sd.uid)
+    return RaggedBatch(token_ids, q_lens, start_pos, page_table, uids)

@@ -1,0 +1,64 @@
+"""Free-list allocator for KV-cache pages.
+
+TPU-native rework of the reference ``BlockedAllocator``
+(``inference/v2/ragged/blocked_allocator.py:11`` — linked-list over a
+pinned torch tensor).  Here the link table is a plain numpy array: there
+is no pinned-memory dance under XLA, and the allocator is purely host
+state — the device only ever sees page *indices* inside block tables.
+
+Page index 0 is reserved as the **null page**: padding tokens in a
+ragged batch scatter their (masked, garbage) KV writes into it, which
+keeps every shape static without conditional writes.  Valid pages are
+therefore 1..num_pages inclusive.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Union
+
+import numpy as np
+
+NULL_PAGE = 0
+
+
+class BlockedAllocator:
+    """O(n)-per-op free-list of KV pages, indices in [1, num_pages]."""
+
+    def __init__(self, num_pages: int) -> None:
+        if num_pages < 1:
+            raise ValueError(
+                f"blocked KV cache needs >= 1 page, got {num_pages}")
+        self._num_pages = num_pages
+        # _next[i] = successor of page i in the free list (1-based pages).
+        self._next = np.arange(2, num_pages + 2, dtype=np.int64)
+        self._head = 1
+        self._free = num_pages
+
+    @property
+    def free_pages(self) -> int:
+        return self._free
+
+    @property
+    def total_pages(self) -> int:
+        return self._num_pages
+
+    def allocate(self, num_pages: int) -> np.ndarray:
+        if num_pages > self._free:
+            raise ValueError(
+                f"cannot allocate {num_pages} pages ({self._free} free)")
+        out = np.empty(num_pages, dtype=np.int32)
+        for i in range(num_pages):
+            out[i] = self._head
+            self._head = int(self._next[self._head - 1])
+        self._free -= num_pages
+        return out
+
+    def free(self, pages: Union[Iterable[int], np.ndarray]) -> None:
+        pages = np.atleast_1d(np.asarray(pages, dtype=np.int64))
+        for p in pages:
+            p = int(p)
+            if not (1 <= p <= self._num_pages):
+                raise ValueError(f"invalid page index {p}")
+            self._next[p - 1] = self._head
+            self._head = p
+        self._free += len(pages)

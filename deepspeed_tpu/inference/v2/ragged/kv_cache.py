@@ -1,0 +1,75 @@
+"""Blocked (paged) KV cache on device.
+
+Reference: ``inference/v2/ragged/kv_cache.py:40`` (``BlockedKVCache``)
+— there, per-layer torch tensors + an allocator, with offload hooks.
+TPU-native layout: ONE stacked array per cache group
+
+    kv : [num_layers, num_pages + 1, page_size, 2, kv_heads, head_dim]
+
+so the per-layer slice falls out of the layer ``lax.scan`` naturally and
+the whole cache is a single donated buffer across forwards (XLA updates
+it in place; no allocator traffic on device).  Page 0 is the null page
+(see blocked_allocator.py) — real pages are 1..num_pages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .blocked_allocator import BlockedAllocator
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    num_layers: int
+    kv_heads: int
+    head_dim: int
+    page_size: int = 64
+    num_pages: int = 1024
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def bytes_per_page(self) -> int:
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return (self.num_layers * self.page_size * 2 * self.kv_heads
+                * self.head_dim * itemsize)
+
+    def total_bytes(self) -> int:
+        return self.bytes_per_page * (self.num_pages + 1)
+
+
+def pages_for_memory(cfg: KVCacheConfig, budget_bytes: int) -> int:
+    """How many pages fit in ``budget_bytes`` (reference sizes its cache
+    from a memory fraction the same way)."""
+    return max(1, budget_bytes // cfg.bytes_per_page)
+
+
+class BlockedKVCache:
+    """Device cache array + host page allocator."""
+
+    def __init__(self, cfg: KVCacheConfig,
+                 sharding: Optional[jax.sharding.Sharding] = None):
+        self.cfg = cfg
+        self.allocator = BlockedAllocator(cfg.num_pages)
+        shape = (cfg.num_layers, cfg.num_pages + 1, cfg.page_size, 2,
+                 cfg.kv_heads, cfg.head_dim)
+        if sharding is not None:
+            self.data = jax.device_put(
+                jnp.zeros(shape, cfg.dtype), sharding)
+        else:
+            self.data = jnp.zeros(shape, cfg.dtype)
+
+    @property
+    def free_pages(self) -> int:
+        return self.allocator.free_pages
+
+    def reserve(self, num_pages: int):
+        return self.allocator.allocate(num_pages)
+
+    def release(self, pages) -> None:
+        if len(pages):
+            self.allocator.free(pages)

@@ -1,0 +1,61 @@
+"""Persistent state manager: tracked sequences + blocked KV cache.
+
+Reference: ``inference/v2/ragged/ragged_manager.py:19`` (``DSStateManager``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .kv_cache import BlockedKVCache, KVCacheConfig
+from .sequence import SequenceDescriptor
+
+
+class StateManager:
+    def __init__(self, kv_config: KVCacheConfig,
+                 max_tracked_sequences: int = 2048,
+                 kv_sharding=None):
+        self.kv_config = kv_config
+        self.max_tracked_sequences = max_tracked_sequences
+        self.kv_cache = BlockedKVCache(kv_config, sharding=kv_sharding)
+        self._seqs: Dict[int, SequenceDescriptor] = {}
+
+    # -- sequence tracking --------------------------------------------------
+    @property
+    def n_tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    @property
+    def free_pages(self) -> int:
+        return self.kv_cache.free_pages
+
+    def get_sequence(self, uid: int) -> Optional[SequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid: int) -> SequenceDescriptor:
+        sd = self._seqs.get(uid)
+        if sd is None:
+            if len(self._seqs) >= self.max_tracked_sequences:
+                raise RuntimeError(
+                    f"tracked-sequence limit {self.max_tracked_sequences} hit")
+            sd = SequenceDescriptor(uid=uid)
+            self._seqs[uid] = sd
+        return sd
+
+    def flush_sequence(self, uid: int) -> None:
+        sd = self._seqs.pop(uid, None)
+        if sd is not None:
+            self.kv_cache.release(sd.pages)
+
+    # -- KV accounting ------------------------------------------------------
+    def pages_needed(self, sd: SequenceDescriptor, n_new_tokens: int) -> int:
+        """Extra pages required to hold ``n_new_tokens`` more tokens."""
+        page = self.kv_config.page_size
+        total = sd.seen_tokens + n_new_tokens
+        need = -(-total // page)  # ceil
+        return max(0, need - sd.allocated_capacity)
+
+    def allocate_for(self, sd: SequenceDescriptor, n_new_tokens: int) -> None:
+        extra = self.pages_needed(sd, n_new_tokens)
+        if extra:
+            sd.extend_pages(self.kv_cache.reserve(extra))

@@ -1,0 +1,210 @@
+"""Continuous-batching scheduler (Dynamic SplitFuse).
+
+The reference keeps this in the MII project and engine_v2 only exposes
+the ``query/can_schedule/put/flush`` contract (engine_v2.py:158-251);
+SURVEY §3.4 calls for the scheduler in-repo.  Policy (Dynamic SplitFuse,
+FastGen blog): every step fills a fixed token budget — running decodes
+first (one token each), then prompt *chunks* from admitted requests, so
+long prompts are split across steps and fused with decodes, keeping
+per-step latency flat.
+
+Admission runs on incremental page/token/sequence counters (O(1) per
+candidate) rather than re-validating the whole batch through
+``can_schedule`` for each addition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .engine import InferenceEngineV2
+from .sampling import SamplingParams, sample
+
+
+@dataclasses.dataclass
+class Request:
+    uid: int
+    prompt: np.ndarray                  # int32 [prompt_len]
+    params: SamplingParams
+    #: tokens of the prompt already sent to the engine
+    prompt_sent: int = 0
+    generated: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+    @property
+    def prefill_remaining(self) -> int:
+        return len(self.prompt) - self.prompt_sent
+
+
+class _Admission:
+    """Incremental per-step budget accounting mirroring the checks of
+    ``InferenceEngineV2.can_schedule``."""
+
+    def __init__(self, engine: InferenceEngineV2, token_budget: int):
+        sm = engine._config.state_manager
+        self.engine = engine
+        self.free_pages = engine.free_blocks
+        self.tokens_left = min(token_budget, sm.max_ragged_batch_size)
+        self.seqs_left = sm.max_ragged_sequence_count
+        self.tracked_left = (sm.max_tracked_sequences
+                             - engine.state_manager.n_tracked_sequences)
+
+    def try_admit(self, uid: int, n_tokens: int, is_new: bool) -> bool:
+        if (self.seqs_left < 1 or self.tokens_left < n_tokens
+                or (is_new and self.tracked_left < 1)):
+            return False
+        tokens, pages = self.engine.query(uid, n_tokens, self.free_pages)
+        if tokens != n_tokens:
+            return False
+        self.free_pages -= pages
+        self.tokens_left -= n_tokens
+        self.seqs_left -= 1
+        if is_new:
+            self.tracked_left -= 1
+        return True
+
+
+class FastGenScheduler:
+    """Drives an InferenceEngineV2 with the SplitFuse policy."""
+
+    def __init__(self, engine: InferenceEngineV2,
+                 token_budget: Optional[int] = None,
+                 rng: Optional[jax.Array] = None):
+        self._engine = engine
+        self._budget = (token_budget or
+                        engine._config.state_manager.max_ragged_batch_size)
+        self._pending: List[Request] = []     # waiting for first prefill
+        self._running: Dict[int, Request] = {}
+        self._rng = rng if rng is not None else jax.random.key(0)
+        self.last_step_scheduled = 0
+
+    # -- request lifecycle ---------------------------------------------------
+    def submit(self, uid: int, prompt: Sequence[int],
+               params: Optional[SamplingParams] = None) -> None:
+        self._pending.append(Request(
+            uid=uid, prompt=np.asarray(prompt, dtype=np.int32),
+            params=params or SamplingParams()))
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._pending or self._running)
+
+    # -- one engine step -----------------------------------------------------
+    def step(self, on_token: Optional[Callable[[int, int], None]] = None
+             ) -> Dict[int, int]:
+        """Schedule one ragged batch; returns {uid: new_token} for every
+        sequence that produced a token this step."""
+        uids: List[int] = []
+        tokens: List[np.ndarray] = []
+        reqs: List[Request] = []
+        adm = _Admission(self._engine, self._budget)
+
+        # 1. all running decodes (one token each)
+        for uid, req in self._running.items():
+            if req.prefill_remaining > 0:
+                continue  # mid-prefill requests handled below
+            if not adm.try_admit(uid, 1, is_new=False):
+                continue
+            last = req.generated[-1] if req.generated else int(req.prompt[-1])
+            uids.append(uid)
+            tokens.append(np.array([last], dtype=np.int32))
+            reqs.append(req)
+
+        # 2. continue partial prefills, then admit pending, chunked to budget
+        def try_prefill(req: Request, is_new: bool) -> bool:
+            if adm.tokens_left <= 0 or req.prefill_remaining == 0:
+                return False
+            chunk = min(req.prefill_remaining, adm.tokens_left)
+            while chunk > 0 and not adm.try_admit(req.uid, chunk, is_new):
+                chunk //= 2  # shrink to fit KV headroom
+            if chunk == 0:
+                return False
+            piece = req.prompt[req.prompt_sent:req.prompt_sent + chunk]
+            uids.append(req.uid)
+            tokens.append(piece.astype(np.int32))
+            reqs.append(req)
+            req.prompt_sent += chunk
+            return True
+
+        for req in list(self._running.values()):
+            try_prefill(req, is_new=False)
+        while self._pending and adm.tokens_left > 0:
+            req = self._pending[0]
+            if not try_prefill(req, is_new=True):
+                break
+            self._pending.pop(0)
+            self._running[req.uid] = req
+
+        self.last_step_scheduled = len(uids)
+        if not uids:
+            return {}
+
+        logits = self._engine.put(uids, tokens, do_checks=False)
+        out: Dict[int, int] = {}
+
+        # sample — one kernel per distinct sampling-params group
+        sampled_rows = [i for i, r in enumerate(reqs)
+                        if r.prefill_remaining == 0]
+        groups: Dict[tuple, List[int]] = {}
+        for i in sampled_rows:
+            p = reqs[i].params
+            groups.setdefault((p.temperature, p.top_k, p.top_p),
+                              []).append(i)
+        new_tokens: Dict[int, int] = {}
+        for (temp, top_k, top_p), idxs in groups.items():
+            self._rng, key = jax.random.split(self._rng)
+            toks = np.asarray(sample(logits[np.asarray(idxs)], key,
+                                     temperature=temp, top_k=top_k,
+                                     top_p=top_p))
+            for i, t in zip(idxs, toks):
+                new_tokens[i] = int(t)
+
+        for i, tok in new_tokens.items():
+            req = reqs[i]
+            req.generated.append(tok)
+            out[req.uid] = tok
+            if on_token is not None:
+                on_token(req.uid, tok)
+            stop = req.params.stop_token
+            if (len(req.generated) >= req.params.max_new_tokens
+                    or (stop is not None and tok == stop)):
+                req.done = True
+                self._engine.flush(req.uid)
+                del self._running[req.uid]
+        return out
+
+    # -- convenience ---------------------------------------------------------
+    def run_to_completion(self) -> Dict[int, List[int]]:
+        all_reqs = {r.uid: r for r in self._pending}
+        all_reqs.update(self._running)
+        stalls = 0
+        while self.has_work:
+            self.step()
+            if self.last_step_scheduled == 0:
+                stalls += 1
+                if stalls >= 2:
+                    raise RuntimeError(
+                        "scheduler deadlock: work remains but nothing is "
+                        "schedulable (KV cache exhausted or a request "
+                        "exceeds engine limits); "
+                        f"{len(self._pending)} pending, "
+                        f"{len(self._running)} running, "
+                        f"{self._engine.free_blocks} free KV pages")
+            else:
+                stalls = 0
+        return {uid: req.generated for uid, req in all_reqs.items()}
+
+
+def generate(engine: InferenceEngineV2, prompts: Sequence[Sequence[int]],
+             params: Optional[SamplingParams] = None,
+             token_budget: Optional[int] = None) -> List[List[int]]:
+    """Batch generation convenience over the scheduler."""
+    sched = FastGenScheduler(engine, token_budget=token_budget)
+    for i, p in enumerate(prompts):
+        sched.submit(i, p, params)
+    results = sched.run_to_completion()
+    return [results[i] for i in range(len(prompts))]

@@ -1,0 +1,132 @@
+"""Paged (blocked-KV) attention for ragged inference batches.
+
+TPU-native replacement for the FastGen ragged kernel set
+(``inference/v2/kernels/ragged_ops/``: ``blocked_flash`` paged
+attention, ``linear_blocked_kv_rotary`` fused KV-write+RoPE,
+``logits_gather``).  The CUDA path splits sequences into "atoms" sized
+to thread blocks; on TPU the ragged batch is instead padded to a static
+``[S, Q]`` grid (see ragged/batch.py) and the three kernels become:
+
+* ``write_kv``        — scatter new K/V into cache pages (null page 0
+                        absorbs padding writes, keeping shapes static).
+* ``paged_attention`` — gather each slot's pages and run masked GQA
+                        attention over ``[S, C]`` context; everything is
+                        dense einsum -> MXU, raggedness lives in masks.
+* ``gather_last``     — last-token hidden-state gather for logits.
+
+A Pallas kernel specializes the decode path (Q=1) to avoid
+materializing the gathered ``[S, C, K, D]`` context in HBM; the jnp
+formulation below is the semantics ground truth and the CPU/CI path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+def token_positions(start_pos: jax.Array, q_len_max: int) -> jax.Array:
+    """pos[s, i] = start_pos[s] + i  (int32, [S, Q])."""
+    return start_pos[:, None] + jnp.arange(q_len_max, dtype=jnp.int32)[None, :]
+
+
+def write_kv(kv_layer: jax.Array, k_new: jax.Array, v_new: jax.Array,
+             page_table: jax.Array, start_pos: jax.Array,
+             q_lens: jax.Array) -> jax.Array:
+    """Scatter new KV into the cache pages of one layer.
+
+    kv_layer : [num_pages+1, page_size, 2, K, D]
+    k_new/v_new : [S, Q, K, D]
+    Returns the updated kv_layer (functional; donate at jit boundary).
+    """
+    S, Q = k_new.shape[:2]
+    page_size = kv_layer.shape[1]
+    pos = token_positions(start_pos, Q)                     # [S, Q]
+    valid = jnp.arange(Q, dtype=jnp.int32)[None, :] < q_lens[:, None]
+    page_idx_in_seq = pos // page_size
+    slot = pos % page_size
+    pages = jnp.take_along_axis(page_table, page_idx_in_seq, axis=1)
+    pages = jnp.where(valid, pages, 0)                      # null page
+    pages_f = pages.reshape(-1)
+    slot_f = slot.reshape(-1)
+    kv_new = jnp.stack([k_new, v_new], axis=2)              # [S,Q,2,K,D]
+    kv_f = kv_new.reshape((S * Q,) + kv_new.shape[2:]).astype(kv_layer.dtype)
+    return kv_layer.at[pages_f, slot_f].set(kv_f, mode="drop")
+
+
+def paged_attention(q: jax.Array, kv_layer: jax.Array,
+                    page_table: jax.Array, start_pos: jax.Array,
+                    q_lens: jax.Array, *,
+                    sm_scale: float | None = None) -> jax.Array:
+    """Masked GQA attention of [S, Q] new tokens over their paged context.
+
+    q        : [S, Q, H, D]    (H = K * groups)
+    kv_layer : [num_pages+1, page_size, 2, K, D] (new KV already written)
+    Returns  : [S, Q, H, D]
+    """
+    S, Q, H, D = q.shape
+    page_size = kv_layer.shape[1]
+    K = kv_layer.shape[3]
+    G = H // K
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+
+    pages = kv_layer[page_table]                # [S, P, page, 2, K, D]
+    P = pages.shape[1]
+    C = P * page_size
+    k = pages[..., 0, :, :].reshape(S, C, K, D)
+    v = pages[..., 1, :, :].reshape(S, C, K, D)
+
+    qg = q.reshape(S, Q, K, G, D)
+    scores = jnp.einsum("sqkgd,sckd->skgqc", qg, k).astype(jnp.float32) * scale
+
+    pos = token_positions(start_pos, Q)                     # [S, Q]
+    ctx = jnp.arange(C, dtype=jnp.int32)
+    # context element c visible to query (s, i) iff c <= pos[s, i]; the
+    # page gather places context position c at row c of the flattened
+    # pages exactly (pages are filled in order).
+    mask = ctx[None, None, :] <= pos[:, :, None]            # [S, Q, C]
+    # null-page / unallocated-page rows beyond the sequence never pass
+    # the causal check since pos < allocated capacity * page_size.
+    scores = jnp.where(mask[:, None, None, :, :], scores, MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("skgqc,sckd->sqkgd", probs, v)
+    return out.reshape(S, Q, H, D)
+
+
+def gather_last(x: jax.Array, q_lens: jax.Array) -> jax.Array:
+    """Last valid token's hidden state per slot: [S, Q, E] -> [S, E]
+    (reference ``logits_gather`` kernel)."""
+    idx = jnp.maximum(q_lens - 1, 0)
+    return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+
+
+def attention_reference(q, k_ctx, v_ctx, start_pos, q_lens) -> jax.Array:
+    """Dense ground-truth for tests: same masking over an unpaged
+    [S, C, K, D] context."""
+    S, Q, H, D = q.shape
+    K = k_ctx.shape[2]
+    qg = q.reshape(S, Q, K, H // K, D)
+    scores = jnp.einsum("sqkgd,sckd->skgqc", qg, k_ctx).astype(jnp.float32)
+    scores = scores / np.sqrt(D)
+    C = k_ctx.shape[1]
+    pos = token_positions(start_pos, Q)
+    mask = jnp.arange(C)[None, None, :] <= pos[:, :, None]
+    scores = jnp.where(mask[:, None, None, :, :], scores, MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
+    out = jnp.einsum("skgqc,sckd->sqkgd", probs, v_ctx)
+    return out.reshape(S, Q, H, D)
+
+
+def paged_context(kv_layer: jax.Array, page_table: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Materialize a slot's context (testing helper)."""
+    pages = kv_layer[page_table]
+    S, P, page_size = pages.shape[:3]
+    k = pages[..., 0, :, :].reshape(S, P * page_size, *pages.shape[4:])
+    v = pages[..., 1, :, :].reshape(S, P * page_size, *pages.shape[4:])
+    return k, v
