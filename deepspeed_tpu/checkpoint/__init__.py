@@ -1,0 +1,12 @@
+from .engine import CheckpointEngine, OrbaxCheckpointEngine
+from .hf import from_pretrained, load_gpt2, load_llama
+from .zero_to_fp32 import (convert_zero_checkpoint_to_fp32_state_dict,
+                           flatten_state_dict,
+                           get_fp32_state_dict_from_zero_checkpoint)
+
+__all__ = [
+    "CheckpointEngine", "OrbaxCheckpointEngine", "from_pretrained",
+    "load_gpt2", "load_llama",
+    "convert_zero_checkpoint_to_fp32_state_dict", "flatten_state_dict",
+    "get_fp32_state_dict_from_zero_checkpoint",
+]

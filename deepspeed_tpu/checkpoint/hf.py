@@ -1,0 +1,198 @@
+"""HuggingFace checkpoint import — HF weights -> our param pytree.
+
+Reference: ``inference/v2/checkpoint/huggingface_engine.py`` (streams HF
+safetensors into the inference param layer) and the v1 checkpoint
+loaders (``module_inject/load_checkpoint.py``).  Here one converter
+serves training and inference since both share the transformer core's
+param tree (models/transformer.py).
+
+Supported families: LLaMA/Mistral-style (rmsnorm + gated silu + rope)
+and GPT-2 style (layernorm + gelu + learned positions, fused c_attn).
+
+RoPE convention: models/transformer.py rotates interleaved pairs
+(Meta/original convention).  HF checkpoints store q/k projections
+permuted for the half-split ("rotate_half") convention, so the import
+applies the inverse permutation to q/k weight rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+
+
+def _np(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    try:  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    except AttributeError:
+        return np.asarray(t)
+
+
+def _unpermute_rope(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """Invert the HF conversion permute: [H*D, E] rows from half-split
+    order back to interleaved order."""
+    E = w.shape[1]
+    w = w.reshape(n_heads, 2, head_dim // 2, E)
+    w = np.transpose(w, (0, 2, 1, 3))  # (H, D/2, 2, E)
+    return w.reshape(n_heads * head_dim, E)
+
+
+def llama_config_from_hf(hf_cfg) -> TransformerConfig:
+    """Map a transformers LlamaConfig/MistralConfig to TransformerConfig."""
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=getattr(hf_cfg, "num_key_value_heads",
+                             hf_cfg.num_attention_heads),
+        max_seq_len=getattr(hf_cfg, "max_position_embeddings", 4096),
+        norm="rmsnorm", norm_eps=hf_cfg.rms_norm_eps,
+        activation="silu_gated", pos_emb="rope",
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        use_bias=False, dtype=jnp.bfloat16)
+
+
+def load_llama(state_dict: Dict[str, Any], cfg: TransformerConfig,
+               dtype=jnp.float32) -> Dict[str, Any]:
+    """HF LLaMA/Mistral state dict -> our (unboxed) param tree."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    E = cfg.hidden_size
+    H, K, D = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+
+    def key(*names):
+        for n in names:
+            if n in sd:
+                return sd[n]
+        raise KeyError(f"none of {names} in checkpoint "
+                       f"(have e.g. {list(sd)[:5]})")
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        wq = _unpermute_rope(key(p + "self_attn.q_proj.weight"), H, D)
+        wk = _unpermute_rope(key(p + "self_attn.k_proj.weight"), K, D)
+        wv = key(p + "self_attn.v_proj.weight")
+        wo = key(p + "self_attn.o_proj.weight")
+        layers.append({
+            "attn": {
+                "wq": wq.T.reshape(E, H, D),
+                "wk": wk.T.reshape(E, K, D),
+                "wv": wv.T.reshape(E, K, D),
+                "wo": wo.T.reshape(H, D, E),
+            },
+            "mlp": {
+                "wg": key(p + "mlp.gate_proj.weight").T,
+                "wi": key(p + "mlp.up_proj.weight").T,
+                "wo": key(p + "mlp.down_proj.weight").T,
+            },
+            "norm1": {"scale": key(p + "input_layernorm.weight")},
+            "norm2": {"scale": key(p + "post_attention_layernorm.weight")},
+        })
+
+    params: Dict[str, Any] = {
+        "embed": {"tokens": key("model.embed_tokens.weight")},
+        "layers": _stack(layers) if cfg.scan_layers
+        else {f"layer_{i}": l for i, l in enumerate(layers)},
+        "final_norm": {"scale": key("model.norm.weight")},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = key("lm_head.weight").T
+    return _cast(params, dtype)
+
+
+def gpt2_config_from_hf(hf_cfg) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.n_embd,
+        intermediate_size=4 * hf_cfg.n_embd,
+        num_layers=hf_cfg.n_layer,
+        num_heads=hf_cfg.n_head,
+        num_kv_heads=hf_cfg.n_head,
+        max_seq_len=hf_cfg.n_positions,
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_epsilon,
+        activation="gelu", pos_emb="learned",
+        tie_embeddings=True, use_bias=True, dtype=jnp.bfloat16)
+
+
+def load_gpt2(state_dict: Dict[str, Any], cfg: TransformerConfig,
+              dtype=jnp.float32) -> Dict[str, Any]:
+    """HF GPT-2 state dict -> our param tree.  GPT-2's Conv1D stores
+    weights [in, out] (already our orientation)."""
+    sd = {k.removeprefix("transformer."): _np(v)
+          for k, v in state_dict.items()}
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.dims_per_head
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        w_qkv = sd[p + "attn.c_attn.weight"]      # [E, 3E]
+        b_qkv = sd[p + "attn.c_attn.bias"]        # [3E]
+        wq, wk, wv = np.split(w_qkv, 3, axis=1)
+        bq, bk, bv = np.split(b_qkv, 3)
+        layers.append({
+            "attn": {
+                "wq": wq.reshape(E, H, D), "wk": wk.reshape(E, H, D),
+                "wv": wv.reshape(E, H, D),
+                "wo": sd[p + "attn.c_proj.weight"].reshape(H, D, E),
+                "bq": bq.reshape(H, D), "bk": bk.reshape(H, D),
+                "bv": bv.reshape(H, D),
+                "bo": sd[p + "attn.c_proj.bias"],
+            },
+            "mlp": {
+                "wi": sd[p + "mlp.c_fc.weight"],
+                "bi": sd[p + "mlp.c_fc.bias"],
+                "wo": sd[p + "mlp.c_proj.weight"],
+                "bo": sd[p + "mlp.c_proj.bias"],
+            },
+            "norm1": {"scale": sd[p + "ln_1.weight"],
+                      "bias": sd[p + "ln_1.bias"]},
+            "norm2": {"scale": sd[p + "ln_2.weight"],
+                      "bias": sd[p + "ln_2.bias"]},
+        })
+    params = {
+        "embed": {"tokens": sd["wte.weight"],
+                  "positions": sd["wpe.weight"]},
+        "layers": _stack(layers) if cfg.scan_layers
+        else {f"layer_{i}": l for i, l in enumerate(layers)},
+        "final_norm": {"scale": sd["ln_f.weight"],
+                       "bias": sd["ln_f.bias"]},
+    }
+    return _cast(params, dtype)
+
+
+def from_pretrained(model_or_path, dtype=jnp.float32
+                    ) -> Tuple[TransformerConfig, Dict[str, Any]]:
+    """Convert a transformers model instance or local checkpoint dir."""
+    if isinstance(model_or_path, str):
+        import transformers
+        model = transformers.AutoModelForCausalLM.from_pretrained(
+            model_or_path, local_files_only=True)
+    else:
+        model = model_or_path
+    arch = model.config.model_type
+    sd = model.state_dict()
+    if arch in ("llama", "mistral"):
+        cfg = llama_config_from_hf(model.config)
+        return cfg, load_llama(sd, cfg, dtype)
+    if arch == "gpt2":
+        cfg = gpt2_config_from_hf(model.config)
+        return cfg, load_gpt2(sd, cfg, dtype)
+    raise ValueError(f"unsupported HF architecture: {arch!r}")
+
+
+def _stack(layers):
+    import jax
+    return jax.tree.map(lambda *xs: np.stack(xs), *layers)
+
+
+def _cast(tree, dtype):
+    import jax
+    return jax.tree.map(lambda x: jnp.asarray(x, dtype), tree)

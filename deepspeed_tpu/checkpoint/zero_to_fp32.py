@@ -1,0 +1,86 @@
+"""Offline fp32 reconstruction from a (sharded) checkpoint directory.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` — stitches per-rank zero
+shard files back into a consolidated fp32 state dict, offline.  Here
+checkpoints are Orbax/tensorstore directories whose array storage is
+already logically whole (shards are an Orbax storage detail), so
+"reconstruction" is a host-side restore of the ``params`` subtree; no
+per-rank shard walking is needed, and any (dp, tp, pp) topology change
+between save and load is absorbed by restore-time sharding (the
+universal-checkpoint property, reference ``deepspeed/checkpoint/``).
+
+CLI:  python -m deepspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <out.npz>
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .engine import LATEST_FILE
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, Any]:
+    """Load the consolidated fp32 param tree from a checkpoint dir on
+    host memory (no engine, no mesh required)."""
+    import orbax.checkpoint as ocp
+
+    if tag is None:
+        latest = os.path.join(ckpt_dir, LATEST_FILE)
+        if not os.path.exists(latest):
+            raise FileNotFoundError(
+                f"no tag given and no '{LATEST_FILE}' file in {ckpt_dir}")
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.abspath(os.path.join(ckpt_dir, tag, "state"))
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint state dir not found: {path}")
+    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+    state = ckptr.restore(path)
+    params = state["params"] if isinstance(state, dict) else state.params
+    return _tree_to_host_fp32(params)
+
+
+def _tree_to_host_fp32(tree: Any) -> Any:
+    import jax
+    return jax.tree.map(
+        lambda x: np.asarray(x, dtype=np.float32), tree)
+
+
+def flatten_state_dict(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Nested dict -> flat {'a.b.c': array} (torch-state-dict style keys)."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(flatten_state_dict(v, f"{prefix}{k}."))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        ckpt_dir: str, output_file: str, tag: Optional[str] = None) -> None:
+    params = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    flat = flatten_state_dict(params)
+    np.savez(output_file, **flat)
+    total = sum(v.size for v in flat.values())
+    print(f"saved {len(flat)} tensors / {total:,} params -> {output_file}")
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) not in (2, 3):
+        print("usage: python -m deepspeed_tpu.checkpoint.zero_to_fp32 "
+              "<checkpoint_dir> <output.npz> [tag]")
+        return 1
+    convert_zero_checkpoint_to_fp32_state_dict(
+        argv[0], argv[1], argv[2] if len(argv) == 3 else None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

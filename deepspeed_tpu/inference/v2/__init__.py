@@ -1,6 +1,7 @@
 from .config import (KVCacheUserConfig, RaggedInferenceEngineConfig,
                      StateManagerConfig)
 from .engine import InferenceEngineV2, SchedulingError, SchedulingResult
+from .factory import build_hf_engine
 from .model import RaggedInferenceModel
 from .ragged import (BlockedAllocator, BlockedKVCache, KVCacheConfig,
                      RaggedBatch, StateManager, build_batch)
@@ -10,6 +11,7 @@ from .scheduler import FastGenScheduler, Request, generate
 __all__ = [
     "KVCacheUserConfig", "RaggedInferenceEngineConfig", "StateManagerConfig",
     "InferenceEngineV2", "SchedulingError", "SchedulingResult",
+    "build_hf_engine",
     "RaggedInferenceModel", "BlockedAllocator", "BlockedKVCache",
     "KVCacheConfig", "RaggedBatch", "StateManager", "build_batch",
     "SamplingParams", "sample", "FastGenScheduler", "Request", "generate",

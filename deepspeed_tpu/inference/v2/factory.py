@@ -1,0 +1,29 @@
+"""Engine factory — HF checkpoint -> ready InferenceEngineV2.
+
+Reference: ``inference/v2/engine_factory.py`` (``build_hf_engine``
+resolves the model architecture to a policy and loads the checkpoint).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...checkpoint.hf import from_pretrained
+from .config import RaggedInferenceEngineConfig
+from .engine import InferenceEngineV2
+from .model import RaggedInferenceModel
+from .ragged import KVCacheConfig
+
+
+def build_hf_engine(model_or_path: Any,
+                    engine_config: Optional[RaggedInferenceEngineConfig] = None,
+                    mesh: Optional[jax.sharding.Mesh] = None,
+                    dtype=None) -> InferenceEngineV2:
+    """Build a ragged inference engine from a transformers model instance
+    or a local HF checkpoint directory."""
+    cfg, params = from_pretrained(model_or_path, dtype=dtype or jnp.bfloat16)
+    model = RaggedInferenceModel(cfg, params, mesh=mesh)
+    return InferenceEngineV2(model, engine_config)

@@ -34,6 +34,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import json
+import os
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
@@ -645,3 +647,23 @@ class DeepSpeedEngine:
         rep = NamedSharding(self.topology.mesh, P())
         gathered = jax.jit(lambda p: p, out_shardings=rep)(self.state.params)
         return jax.tree.map(np.asarray, gathered)
+
+    def save_16bit_model(self, save_dir: str,
+                         filename: str = "model_weights.npz"):
+        """Export consolidated bf16 weights for inference handoff
+        (reference ``save_16bit_model`` engine.py:3620)."""
+        from ..checkpoint.zero_to_fp32 import flatten_state_dict
+        params = self.get_fp32_state_dict()
+        flat = {k: v.astype(jnp.bfloat16)
+                for k, v in flatten_state_dict(params).items()}
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, filename)
+        if jax.process_index() == 0:
+            # bf16 has no numpy dtype string npz understands natively;
+            # store as uint16 view + sidecar dtype manifest
+            np.savez(path, **{k: np.asarray(v).view(np.uint16)
+                              for k, v in flat.items()})
+            with open(path + ".dtypes.json", "w") as f:
+                json.dump({k: "bfloat16" for k in flat}, f)
+        logger.info("saved 16-bit model -> %s", path)
+        return path
