@@ -1,0 +1,15 @@
+"""Elastic training (reference ``deepspeed/elasticity/``)."""
+
+from .elasticity import (  # noqa: F401
+    ElasticityConfig,
+    ElasticityError,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    candidate_batch_sizes,
+    compute_elastic_config,
+    elasticity_enabled,
+    get_compatible_chips_v01,
+    get_compatible_chips_v02,
+    valid_chip_counts,
+)
+from .elastic_agent import AgentResult, ElasticAgent  # noqa: F401

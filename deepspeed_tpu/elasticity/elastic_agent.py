@@ -1,0 +1,89 @@
+"""Elastic agent: supervise a launch, rescale + resume on membership change.
+
+TPU-native analogue of ``deepspeed/elasticity/elastic_agent.py:32``
+``DSElasticAgent``.  The reference wraps torch-elastic's rendezvous: on a
+worker join/leave it restarts all ranks and training resumes from the last
+checkpoint at the new world size.  On TPU the equivalent loop is
+pod-reslice + auto-resume: the agent re-probes the host set between
+restarts, verifies the new chip count is in the elastic config's valid set
+(:func:`~deepspeed_tpu.elasticity.compute_elastic_config`), and relaunches;
+the engine's ``load_checkpoint(latest)`` path restores state.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .elasticity import (ElasticityIncompatibleWorldSize,
+                         compute_elastic_config)
+from ..utils.logging import logger
+
+
+@dataclass
+class AgentResult:
+    exit_code: int
+    restarts: int
+    world_sizes: List[int] = field(default_factory=list)
+
+
+class ElasticAgent:
+    """Restart-supervision loop around a launch callable.
+
+    ``launch_fn(world_size) -> int`` runs one training generation and
+    returns its exit code; ``probe_fn() -> int`` reports the currently
+    available chip count (e.g. re-reading the hostfile or querying the TPU
+    pod API).  Injection of both keeps the loop unit-testable without
+    hardware — the same role the reference's pg_sim plays.
+    """
+
+    def __init__(self,
+                 ds_config: Dict,
+                 launch_fn: Callable[[int], int],
+                 probe_fn: Callable[[], int],
+                 max_restarts: int = 100,
+                 restart_backoff_s: float = 5.0):
+        self.ds_config = ds_config
+        self.launch_fn = launch_fn
+        self.probe_fn = probe_fn
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+
+    def _usable_world(self, available: int) -> int:
+        """Largest valid *chip* count <= available.
+
+        ``compute_elastic_config`` returns valid sizes in DP-rank units;
+        with model parallelism each DP rank occupies ``mp`` chips.
+        """
+        final_batch, valid = compute_elastic_config(self.ds_config)
+        mp = int(self.ds_config.get("elasticity", {}).get(
+            "model_parallel_size", 1))
+        usable = max((v * mp for v in valid if v * mp <= available),
+                     default=0)
+        if usable == 0:
+            raise ElasticityIncompatibleWorldSize(
+                f"{available} chips available but valid chip counts are "
+                f"{[v * mp for v in valid]}")
+        return usable
+
+    def run(self) -> AgentResult:
+        restarts = 0
+        history: List[int] = []
+        while True:
+            world = self._usable_world(self.probe_fn())
+            history.append(world)
+            logger.info("elastic agent: generation %d with %d chips",
+                        restarts, world)
+            code = self.launch_fn(world)
+            if code == 0:
+                return AgentResult(0, restarts, history)
+            restarts += 1
+            if restarts > self.max_restarts:
+                logger.error("elastic agent: max restarts exceeded")
+                return AgentResult(code, restarts - 1, history)
+            logger.warning("elastic agent: generation failed (%d); "
+                           "re-probing and restarting in %.1fs",
+                           code, self.restart_backoff_s)
+            time.sleep(self.restart_backoff_s)

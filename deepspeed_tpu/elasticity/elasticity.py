@@ -1,0 +1,279 @@
+"""Elastic training: batch-size-invariant scale-up/down.
+
+TPU-native analogue of ``deepspeed/elasticity/elasticity.py`` (algorithms
+v0.1 ``_get_compatible_gpus_v01`` :83 and v0.2 :126, public API
+``compute_elastic_config`` :233).  The contract: given a maximum acceptable
+global batch and a menu of micro-batch sizes, pick one global batch size
+that is simultaneously divisible by as many chip counts as possible, so the
+job can be rescheduled onto any of those chip counts without changing the
+effective batch (gradient accumulation absorbs the difference:
+``batch = micro * gas * dp_world``).
+
+On TPU "gpu count" reads as *chip count*; v0.2's node granularity reads as
+*host granularity* (a pod reslices in whole hosts), and model-parallel size
+is the product of the non-DP mesh axes (tp·pp·sp·ep).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+
+
+class ElasticityError(RuntimeError):
+    """Generic elasticity failure."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Bad or missing elasticity config."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """Current world size is not in the valid set for this config."""
+
+
+# Highly composite numbers: maximal divisor counts, so scaling a base
+# micro-batch by one of these maximizes the number of chip counts that
+# divide the resulting global batch. Enough entries for ~720K batch.
+_HIGHLY_COMPOSITE = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260,
+    1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360,
+    50400, 55440, 83160, 110880, 166320, 221760, 277200, 332640, 498960,
+    554400, 665280, 720720,
+]
+
+
+@dataclass
+class ElasticityConfig:
+    """Typed view of the ``"elasticity"`` config block."""
+    max_acceptable_batch_size: int
+    micro_batches: List[int]
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.1
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ElasticityConfig":
+        if "max_train_batch_size" not in d and \
+                "max_acceptable_batch_size" not in d:
+            raise ElasticityConfigError(
+                "elasticity config requires 'max_train_batch_size'")
+        micro = d.get("micro_batch_sizes", d.get("micro_batches"))
+        if not micro:
+            raise ElasticityConfigError(
+                "elasticity config requires 'micro_batch_sizes'")
+        if not all(isinstance(m, int) and m > 0 for m in micro):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive ints, got {micro}")
+        cfg = cls(
+            max_acceptable_batch_size=int(
+                d.get("max_train_batch_size",
+                      d.get("max_acceptable_batch_size"))),
+            micro_batches=sorted(set(int(m) for m in micro)),
+            min_gpus=int(d.get("min_gpus", 1)),
+            max_gpus=int(d.get("max_gpus", 10000)),
+            min_time=int(d.get("min_time", 0)),
+            prefer_larger_batch=bool(d.get("prefer_larger_batch", True)),
+            ignore_non_elastic_batch_info=bool(
+                d.get("ignore_non_elastic_batch_info", False)),
+            version=float(d.get("version", 0.1)),
+            model_parallel_size=int(d.get("model_parallel_size", 1)),
+            num_gpus_per_node=int(d.get("num_gpus_per_node", 1)),
+        )
+        if cfg.min_gpus < 1 or cfg.max_gpus < cfg.min_gpus:
+            raise ElasticityConfigError(
+                f"invalid chip range [{cfg.min_gpus}, {cfg.max_gpus}]")
+        return cfg
+
+
+def _scale_to_hcn(base: int, ceiling: int) -> int:
+    """Largest ``base * hcn`` not exceeding ``ceiling`` (>= base)."""
+    if base >= ceiling:
+        return base
+    budget = ceiling // base
+    best = 1
+    for h in _HIGHLY_COMPOSITE:
+        if h > budget:
+            break
+        best = h
+    return base * best
+
+
+def candidate_batch_sizes(micro_batches: Sequence[int],
+                          max_batch: int) -> List[int]:
+    """Candidate global batches: each micro-batch (and their LCM) scaled by
+    the largest highly-composite multiplier that stays under ``max_batch``."""
+    bases = list(micro_batches)
+    bases.append(math.lcm(*micro_batches))
+    cands = {_scale_to_hcn(b, max_batch) for b in bases}
+    # the LCM base can itself exceed the cap; keep the contract batch<=max
+    # (micro batches themselves are validated <= max by the caller)
+    capped = {c for c in cands if c <= max_batch}
+    return sorted(capped or {max(m for m in micro_batches if m <= max_batch)})
+
+
+def valid_chip_counts(batch_size: int, micro_batches: Sequence[int],
+                      min_chips: int, max_chips: int) -> List[int]:
+    """All chip counts g in [min,max] such that some micro-batch evenly
+    tiles: batch = micro * gas * g for integer gas."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro:
+            continue
+        quotient = batch_size // micro  # = gas * chips
+        if min_chips <= quotient <= max_chips:
+            valid.add(quotient)
+        for g in range(1, quotient // 2 + 1):
+            if g > max_chips:
+                break
+            if g >= min_chips and quotient % g == 0:
+                valid.add(g)
+    return sorted(valid)
+
+
+def _best_candidate(cands: Sequence[int], micro_batches: Sequence[int],
+                    min_chips: int, max_chips: int,
+                    prefer_larger: bool) -> Tuple[int, List[int]]:
+    best_batch = min(micro_batches)
+    best_valid: List[int] = []
+    for batch in cands:
+        valid = valid_chip_counts(batch, micro_batches, min_chips, max_chips)
+        better = len(valid) > len(best_valid)
+        tie = len(valid) == len(best_valid)
+        if better or (tie and ((prefer_larger and batch > best_batch) or
+                               (not prefer_larger and batch < best_batch))):
+            best_batch, best_valid = batch, valid
+    return best_batch, best_valid
+
+
+def get_compatible_chips_v01(micro_batches: Sequence[int], max_batch: int,
+                             min_chips: int = 1,
+                             max_chips: Optional[int] = None,
+                             prefer_larger: bool = True
+                             ) -> Tuple[int, List[int]]:
+    """v0.1: pick the global batch with the most compatible chip counts."""
+    if any(m > max_batch for m in micro_batches):
+        raise ElasticityConfigError(
+            f"every micro batch must be <= max batch {max_batch}")
+    max_chips = max_chips or max_batch // min(micro_batches)
+    cands = candidate_batch_sizes(micro_batches, max_batch)
+    return _best_candidate(cands, micro_batches, min_chips, max_chips,
+                           prefer_larger)
+
+
+def get_compatible_chips_v02(micro_batches: Sequence[int], max_batch: int,
+                             current_num_chips: int,
+                             min_chips: int = 1,
+                             max_chips: Optional[int] = None,
+                             prefer_larger: bool = True,
+                             chips_per_host: int = 1,
+                             model_parallel_size: int = 1
+                             ) -> Tuple[int, List[int], Optional[int]]:
+    """v0.2: host-granular + model-parallel aware.
+
+    Chips are allocated in whole hosts; each host contributes
+    ``chips_per_host // model_parallel_size`` data-parallel ranks.  Solves
+    v0.1 at host granularity, then maps back to DP world sizes.  If the
+    *current* allocation is not in the valid set, falls back to the largest
+    batch reachable at the current DP size (so a degraded pod still trains).
+    """
+    if chips_per_host % model_parallel_size:
+        raise ElasticityError(
+            f"chips per host {chips_per_host} must be divisible by "
+            f"model parallel size {model_parallel_size}")
+    dp_per_host = chips_per_host // model_parallel_size
+    min_chips = min_chips or 1
+    max_chips = max_chips or max_batch // min(micro_batches) * chips_per_host
+
+    host_batch, valid_hosts = get_compatible_chips_v01(
+        micro_batches,
+        max_batch // dp_per_host,
+        max(1, min_chips // chips_per_host),
+        max(1, max_chips // chips_per_host),
+        prefer_larger=prefer_larger)
+    final_batch = host_batch * dp_per_host
+    valid_dp = [h * dp_per_host for h in valid_hosts]
+
+    def pick_micro(batch: int, dp: int) -> Optional[int]:
+        choice = None
+        for micro in micro_batches:
+            if dp and batch // dp % micro == 0:
+                if choice is None or (prefer_larger and micro > choice):
+                    choice = micro
+        return choice
+
+    current_dp = current_num_chips // model_parallel_size
+    if current_dp in valid_dp:
+        return final_batch, valid_dp, pick_micro(final_batch, current_dp)
+
+    # degraded path: keep current allocation, maximize batch under the cap
+    cands = [micro * current_dp * (max_batch // (micro * current_dp))
+             for micro in micro_batches if micro * current_dp <= max_batch]
+    if not cands:
+        raise ElasticityIncompatibleWorldSize(
+            f"no batch fits {current_num_chips} chips under {max_batch}")
+    batch = max(cands) if prefer_larger else min(cands)
+    return batch, [current_dp], pick_micro(batch, current_dp)
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def compute_elastic_config(ds_config: Dict, world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Public API (reference ``elasticity.py:233``): resolve
+    ``(final_batch_size, valid_chip_counts[, micro_batch])`` from a config
+    containing an ``"elasticity"`` block.  Deterministic for a given config
+    so the scheduler and the runtime agree."""
+    block = ds_config.get("elasticity")
+    if block is None:
+        raise ElasticityConfigError("'elasticity' missing from config")
+    if not block.get("enabled", False):
+        raise ElasticityConfigError("elasticity is disabled in config")
+    cfg = ElasticityConfig.from_dict(block)
+
+    if cfg.model_parallel_size > 1 and cfg.version < 0.2:
+        raise ElasticityConfigError(
+            "model-parallel elasticity requires version 0.2")
+
+    micro_batch: Optional[int] = None
+    if cfg.version >= 0.2:
+        final_batch, valid, micro_batch = get_compatible_chips_v02(
+            cfg.micro_batches, cfg.max_acceptable_batch_size,
+            current_num_chips=world_size or cfg.num_gpus_per_node,
+            min_chips=cfg.min_gpus, max_chips=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch,
+            chips_per_host=cfg.num_gpus_per_node,
+            model_parallel_size=cfg.model_parallel_size)
+    else:
+        final_batch, valid = get_compatible_chips_v01(
+            cfg.micro_batches, cfg.max_acceptable_batch_size,
+            cfg.min_gpus, cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch)
+
+    if world_size > 0:
+        dp = world_size // cfg.model_parallel_size
+        if dp not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} (dp={dp}) not in valid set {valid}")
+        if micro_batch is None:
+            for micro in sorted(cfg.micro_batches,
+                                reverse=cfg.prefer_larger_batch):
+                if final_batch // dp % micro == 0:
+                    micro_batch = micro
+                    break
+
+    logger.info("elastic config: batch=%d valid_chips=%s micro=%s",
+                final_batch, valid, micro_batch)
+    if return_microbatch or world_size > 0:
+        return final_batch, valid, micro_batch
+    return final_batch, valid

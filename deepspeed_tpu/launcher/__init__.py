@@ -1,0 +1,16 @@
+"""Launcher (reference ``deepspeed/launcher/``): hostfile → world-info →
+per-node process spawn with the RANK/LOCAL_RANK/WORLD_SIZE/MASTER_* env
+contract; multinode fan-out via pdsh/ssh/gcloud/mpirun/srun."""
+
+from .hostfile import fetch_hostfile, filter_resources, parse_hostfile  # noqa: F401
+from .multinode_runner import (  # noqa: F401
+    MultiNodeRunner,
+    PDSHRunner,
+    SSHRunner,
+    GCloudTPURunner,
+    OpenMPIRunner,
+    SlurmRunner,
+    decode_world_info,
+    encode_world_info,
+    select_runner,
+)

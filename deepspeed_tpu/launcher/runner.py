@@ -1,0 +1,135 @@
+"""Top-level launch CLI: ``python -m deepspeed_tpu.launcher.runner train.py ...``
+
+TPU-native analogue of ``deepspeed/launcher/runner.py:388 main()``:
+hostfile → filters → world-info encoding → single-node exec of
+:mod:`.launch` or multinode fan-out via :mod:`.multinode_runner`.
+Elastic configs resolve their world size through
+:func:`deepspeed_tpu.elasticity.compute_elastic_config` before launch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Optional
+
+from .hostfile import fetch_hostfile, filter_resources
+from .multinode_runner import encode_world_info, select_runner
+from ..utils.logging import logger
+
+DEFAULT_MASTER_PORT = 29500
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="deepspeed_tpu",
+        description="launch a deepspeed_tpu training script across hosts")
+    p.add_argument("-H", "--hostfile", default="/job/hostfile",
+                   help="path to 'host slots=N' hostfile")
+    p.add_argument("-i", "--include", default="",
+                   help="host[:slots]@host2 inclusion filter")
+    p.add_argument("-e", "--exclude", default="",
+                   help="host[:slots]@host2 exclusion filter")
+    p.add_argument("--num_nodes", type=int, default=-1,
+                   help="cap the number of hosts used")
+    p.add_argument("--master_addr", default=None)
+    p.add_argument("--master_port", type=int, default=DEFAULT_MASTER_PORT)
+    p.add_argument("--launcher", default="auto",
+                   choices=["auto", "pdsh", "ssh", "gcloud", "openmpi", "slurm"])
+    p.add_argument("--proc_per_chip", action="store_true",
+                   help="one process per slot (CPU-mesh CI mode)")
+    p.add_argument("--tpu_name", default=None)
+    p.add_argument("--tpu_zone", default=None)
+    p.add_argument("--force_multi", action="store_true")
+    p.add_argument("--elastic_training", action="store_true")
+    p.add_argument("--deepspeed_config", "--config", dest="config",
+                   default=None, help="JSON config (for elastic resolution)")
+    p.add_argument("user_script", help="training script to launch")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _resolve_elastic_world(args, resources) -> "OrderedDict[str, int]":
+    """Narrow the host set so global batch stays valid (elastic v0.1/0.2)."""
+    from ..elasticity import compute_elastic_config
+    with open(args.config, "r", encoding="utf-8") as fh:
+        ds_config = json.load(fh)
+    total_slots = sum(resources.values())
+    final_batch, valid_counts = compute_elastic_config(ds_config)
+    # valid_counts are DP-rank units; each DP rank spans mp chips/slots
+    mp = int(ds_config.get("elasticity", {}).get("model_parallel_size", 1))
+    usable = max((c * mp for c in valid_counts if c * mp <= total_slots),
+                 default=0)
+    if usable == 0:
+        raise RuntimeError(
+            f"elastic config has no valid world size <= {total_slots} "
+            f"(valid chip counts: {[c * mp for c in valid_counts]})")
+    logger.info("elastic: using %d of %d slots (batch=%d)",
+                usable, total_slots, final_batch)
+    out: "OrderedDict[str, int]" = OrderedDict()
+    remaining = usable
+    for host, slots in resources.items():
+        take = min(slots, remaining)
+        if take:
+            out[host] = take
+            remaining -= take
+    return out
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    resources = fetch_hostfile(args.hostfile)
+    if resources is None:
+        # single node: local chips only
+        resources = OrderedDict([("localhost", int(os.environ.get(
+            "DS_TPU_LOCAL_SLOTS", "1")))])
+    resources = filter_resources(resources, args.include, args.exclude)
+    if args.num_nodes > 0:
+        resources = OrderedDict(list(resources.items())[:args.num_nodes])
+    if args.elastic_training:
+        if not args.config:
+            raise RuntimeError("--elastic_training requires --deepspeed_config")
+        resources = _resolve_elastic_world(args, resources)
+
+    if args.master_addr is None:
+        first = next(iter(resources))
+        args.master_addr = "127.0.0.1" if first == "localhost" else first
+
+    world_info = encode_world_info(resources)
+    multi_node = args.force_multi or (
+        len(resources) > 1 or next(iter(resources)) != "localhost")
+
+    if not multi_node:
+        from .launch import main as launch_main
+        launch_argv = [f"--world_info={world_info}", "--node_rank=0",
+                       f"--master_addr={args.master_addr}",
+                       f"--master_port={args.master_port}"]
+        if args.proc_per_chip:
+            launch_argv.append("--proc_per_chip")
+        launch_argv.append(args.user_script)
+        user_args = list(args.user_args)
+        if user_args and user_args[0] == "--":
+            user_args = user_args[1:]  # strip only the leading separator
+        launch_argv.extend(user_args)
+        return launch_main(launch_argv)
+
+    runner = select_runner(args.launcher, args, world_info)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend {runner.name!r} not available")
+    # Propagate relevant env to remote hosts (reference exports NCCL_*/PYTHON*;
+    # here the XLA/JAX/TPU families matter).
+    for key, val in os.environ.items():
+        if key.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU_", "DS_TPU_",
+                           "PYTHONPATH")):
+            runner.add_export(key, val)
+    cmd = runner.get_cmd(dict(os.environ), resources)
+    logger.info("launching: %s", " ".join(cmd))
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -504,6 +504,11 @@ class DeepSpeedEngine:
     def loss_scale(self) -> float:
         return float(self.state.loss_scale)
 
+    @property
+    def skipped_steps(self) -> int:
+        """Total steps skipped on fp16 overflow (reference engine attr)."""
+        return int(self.state.skipped_steps)
+
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
