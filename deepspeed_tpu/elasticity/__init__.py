@@ -10,6 +10,7 @@ from .elasticity import (  # noqa: F401
     elasticity_enabled,
     get_compatible_chips_v01,
     get_compatible_chips_v02,
+    usable_chip_count,
     valid_chip_counts,
 )
 from .elastic_agent import AgentResult, ElasticAgent  # noqa: F401

@@ -17,8 +17,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
-from .elasticity import (ElasticityIncompatibleWorldSize,
-                         compute_elastic_config)
+from .elasticity import usable_chip_count
 from ..utils.logging import logger
 
 
@@ -52,21 +51,7 @@ class ElasticAgent:
         self.restart_backoff_s = restart_backoff_s
 
     def _usable_world(self, available: int) -> int:
-        """Largest valid *chip* count <= available.
-
-        ``compute_elastic_config`` returns valid sizes in DP-rank units;
-        with model parallelism each DP rank occupies ``mp`` chips.
-        """
-        final_batch, valid = compute_elastic_config(self.ds_config)
-        mp = int(self.ds_config.get("elasticity", {}).get(
-            "model_parallel_size", 1))
-        usable = max((v * mp for v in valid if v * mp <= available),
-                     default=0)
-        if usable == 0:
-            raise ElasticityIncompatibleWorldSize(
-                f"{available} chips available but valid chip counts are "
-                f"{[v * mp for v in valid]}")
-        return usable
+        return usable_chip_count(self.ds_config, available)
 
     def run(self) -> AgentResult:
         restarts = 0

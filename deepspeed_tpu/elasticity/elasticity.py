@@ -129,13 +129,14 @@ def valid_chip_counts(batch_size: int, micro_batches: Sequence[int],
         if batch_size % micro:
             continue
         quotient = batch_size // micro  # = gas * chips
-        if min_chips <= quotient <= max_chips:
-            valid.add(quotient)
-        for g in range(1, quotient // 2 + 1):
-            if g > max_chips:
-                break
-            if g >= min_chips and quotient % g == 0:
-                valid.add(g)
+        # enumerate divisor pairs in O(sqrt)
+        d = 1
+        while d * d <= quotient:
+            if quotient % d == 0:
+                for g in (d, quotient // d):
+                    if min_chips <= g <= max_chips:
+                        valid.add(g)
+            d += 1
     return sorted(valid)
 
 
@@ -182,8 +183,10 @@ def get_compatible_chips_v02(micro_batches: Sequence[int], max_batch: int,
     Chips are allocated in whole hosts; each host contributes
     ``chips_per_host // model_parallel_size`` data-parallel ranks.  Solves
     v0.1 at host granularity, then maps back to DP world sizes.  If the
-    *current* allocation is not in the valid set, falls back to the largest
-    batch reachable at the current DP size (so a degraded pod still trains).
+    *current* allocation (``current_num_chips > 0``) is not in the valid
+    set, falls back to the largest batch reachable at the current DP size
+    (so a degraded pod still trains); ``current_num_chips == 0`` means "no
+    current allocation" and just returns the valid set.
     """
     if chips_per_host % model_parallel_size:
         raise ElasticityError(
@@ -192,12 +195,20 @@ def get_compatible_chips_v02(micro_batches: Sequence[int], max_batch: int,
     dp_per_host = chips_per_host // model_parallel_size
     min_chips = min_chips or 1
     max_chips = max_chips or max_batch // min(micro_batches) * chips_per_host
+    # host bounds must stay inside [min_chips, max_chips]: round the lower
+    # bound UP and reject a ceiling smaller than one host
+    min_hosts = -(-min_chips // chips_per_host)
+    max_hosts = max_chips // chips_per_host
+    if max_hosts < 1:
+        raise ElasticityConfigError(
+            f"max_gpus {max_chips} is smaller than one host "
+            f"({chips_per_host} chips)")
 
     host_batch, valid_hosts = get_compatible_chips_v01(
         micro_batches,
         max_batch // dp_per_host,
-        max(1, min_chips // chips_per_host),
-        max(1, max_chips // chips_per_host),
+        min_hosts,
+        max_hosts,
         prefer_larger=prefer_larger)
     final_batch = host_batch * dp_per_host
     valid_dp = [h * dp_per_host for h in valid_hosts]
@@ -211,8 +222,9 @@ def get_compatible_chips_v02(micro_batches: Sequence[int], max_batch: int,
         return choice
 
     current_dp = current_num_chips // model_parallel_size
-    if current_dp in valid_dp:
-        return final_batch, valid_dp, pick_micro(final_batch, current_dp)
+    if current_num_chips == 0 or current_dp in valid_dp:
+        micro = pick_micro(final_batch, current_dp) if current_dp else None
+        return final_batch, valid_dp, micro
 
     # degraded path: keep current allocation, maximize batch under the cap
     cands = [micro * current_dp * (max_batch // (micro * current_dp))
@@ -249,7 +261,7 @@ def compute_elastic_config(ds_config: Dict, world_size: int = 0,
     if cfg.version >= 0.2:
         final_batch, valid, micro_batch = get_compatible_chips_v02(
             cfg.micro_batches, cfg.max_acceptable_batch_size,
-            current_num_chips=world_size or cfg.num_gpus_per_node,
+            current_num_chips=world_size,
             min_chips=cfg.min_gpus, max_chips=cfg.max_gpus,
             prefer_larger=cfg.prefer_larger_batch,
             chips_per_host=cfg.num_gpus_per_node,
@@ -277,3 +289,22 @@ def compute_elastic_config(ds_config: Dict, world_size: int = 0,
     if return_microbatch or world_size > 0:
         return final_batch, valid, micro_batch
     return final_batch, valid
+
+
+def usable_chip_count(ds_config: Dict, available_chips: int) -> int:
+    """Largest valid *chip* count not exceeding ``available_chips``.
+
+    Shared by the launcher's elastic host resolution and the elastic agent
+    so both always agree.  ``compute_elastic_config`` returns valid sizes
+    in DP-rank units; with model parallelism each DP rank spans ``mp``
+    chips.
+    """
+    _, valid = compute_elastic_config(ds_config)
+    mp = ElasticityConfig.from_dict(ds_config["elasticity"]).model_parallel_size
+    usable = max((v * mp for v in valid if v * mp <= available_chips),
+                 default=0)
+    if usable == 0:
+        raise ElasticityIncompatibleWorldSize(
+            f"{available_chips} chips available but valid chip counts are "
+            f"{[v * mp for v in valid]}")
+    return usable

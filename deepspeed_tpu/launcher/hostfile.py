@@ -104,6 +104,13 @@ def filter_resources(resources: "OrderedDict[str, int]",
                                      f"cannot include {bad}")
                 filtered[host] = len(slots)
     else:
+        for host, slots in spec.items():
+            if slots is not None:
+                avail = resources[host]
+                bad = [s for s in slots if s >= avail]
+                if bad:
+                    raise ValueError(f"host {host!r} has {avail} slots; "
+                                     f"cannot exclude {bad}")
         for host, avail in resources.items():
             if host not in spec:
                 filtered[host] = avail

@@ -39,6 +39,10 @@ class MultiNodeRunner(abc.ABC):
         self.args = args
         self.world_info_b64 = world_info_b64
         self.user_arguments: List[str] = list(args.user_args or [])
+        # strip the argparse REMAINDER separator once, so direct-exec
+        # backends (mpirun/srun) agree with the launch.py path
+        if self.user_arguments and self.user_arguments[0] == "--":
+            self.user_arguments = self.user_arguments[1:]
         self.user_script: str = args.user_script
         self.exports: Dict[str, str] = {}
 
@@ -184,8 +188,18 @@ class SlurmRunner(MultiNodeRunner):
         return _which("srun")
 
     def get_cmd(self, environment, active_resources):
-        total_procs = len(active_resources)
-        cmd = ["srun", "-n", str(total_procs)]
+        per_chip = getattr(self.args, "proc_per_chip", False)
+        if per_chip:
+            total_procs = sum(active_resources.values())
+            tasks_per_node = max(active_resources.values())
+        else:
+            total_procs = len(active_resources)
+            tasks_per_node = 1
+        cmd = ["srun", "-n", str(total_procs),
+               "--ntasks-per-node", str(tasks_per_node),
+               # pin placement to the filtered host list; srun would
+               # otherwise ignore include/exclude entirely
+               "-w", ",".join(active_resources.keys())]
         if self.exports:
             # ALL first: a bare list would REPLACE the environment on the
             # compute nodes (dropping PATH/LD_LIBRARY_PATH/venv vars)

@@ -55,28 +55,44 @@ def parse_args(argv=None):
 
 def _resolve_elastic_world(args, resources) -> "OrderedDict[str, int]":
     """Narrow the host set so global batch stays valid (elastic v0.1/0.2)."""
-    from ..elasticity import compute_elastic_config
+    from ..elasticity import usable_chip_count
     with open(args.config, "r", encoding="utf-8") as fh:
         ds_config = json.load(fh)
-    total_slots = sum(resources.values())
-    final_batch, valid_counts = compute_elastic_config(ds_config)
-    # valid_counts are DP-rank units; each DP rank spans mp chips/slots
-    mp = int(ds_config.get("elasticity", {}).get("model_parallel_size", 1))
-    usable = max((c * mp for c in valid_counts if c * mp <= total_slots),
-                 default=0)
-    if usable == 0:
+    if args.proc_per_chip:
+        # per-chip processes: any slot subset is enforceable
+        total = sum(resources.values())
+        usable = usable_chip_count(ds_config, total)
+        out: "OrderedDict[str, int]" = OrderedDict()
+        remaining = usable
+        for host, slots in resources.items():
+            take = min(slots, remaining)
+            if take:
+                out[host] = take
+                remaining -= take
+        logger.info("elastic: using %d of %d slots", usable, total)
+        return out
+    # per-host processes own ALL local chips, so a partial host cannot be
+    # enforced — take the longest whole-host prefix whose chip sum is
+    # exactly a valid elastic count
+    hosts = list(resources.items())
+    best_k = 0
+    prefix = 0
+    valid_prefixes = []
+    for k, (_, slots) in enumerate(hosts, start=1):
+        prefix += slots
+        try:
+            if usable_chip_count(ds_config, prefix) == prefix:
+                valid_prefixes.append(k)
+        except Exception:
+            pass
+    if not valid_prefixes:
         raise RuntimeError(
-            f"elastic config has no valid world size <= {total_slots} "
-            f"(valid chip counts: {[c * mp for c in valid_counts]})")
-    logger.info("elastic: using %d of %d slots (batch=%d)",
-                usable, total_slots, final_batch)
-    out: "OrderedDict[str, int]" = OrderedDict()
-    remaining = usable
-    for host, slots in resources.items():
-        take = min(slots, remaining)
-        if take:
-            out[host] = take
-            remaining -= take
+            f"no whole-host prefix of {dict(resources)} sums to a valid "
+            f"elastic chip count")
+    best_k = valid_prefixes[-1]
+    out = OrderedDict(hosts[:best_k])
+    logger.info("elastic: using %d whole host(s), %d chips", best_k,
+                sum(out.values()))
     return out
 
 

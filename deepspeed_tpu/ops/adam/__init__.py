@@ -1,0 +1,3 @@
+"""Adam ops (reference ``deepspeed/ops/adam``)."""
+
+from .cpu_adam import DeepSpeedCPUAdam, DeepSpeedCPUAdagrad, DeepSpeedCPULion  # noqa: F401

@@ -1,0 +1,3 @@
+"""Async NVMe I/O (reference ``deepspeed/ops/aio`` + ``csrc/aio``)."""
+
+from .async_io import AsyncIOError, AsyncIOHandle  # noqa: F401

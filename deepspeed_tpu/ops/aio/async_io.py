@@ -1,0 +1,87 @@
+"""ctypes wrapper over the native async file-I/O thread pool.
+
+TPU-native analogue of ``deepspeed_py_aio_handle`` (reference
+``csrc/aio/py_lib``): submit pread/pwrite of numpy buffers against NVMe
+paths, overlap with device compute, wait on completion.  The swap-tensor
+layer (``runtime/swap_tensor``) builds its param/optimizer swappers on this.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict
+
+import numpy as np
+
+from ..op_builder import AsyncIOBuilder
+
+
+class AsyncIOError(OSError):
+    pass
+
+
+class AsyncIOHandle:
+    """A pool of I/O threads servicing async reads/writes of numpy buffers.
+
+    The caller must keep a submitted buffer alive until its request is
+    waited on; the handle tracks buffers to enforce that.
+    """
+
+    def __init__(self, num_threads: int = 4, block_size: int = 1 << 20):
+        self._lib = AsyncIOBuilder().load()
+        self._handle = self._lib.ds_aio_create(int(num_threads),
+                                               int(block_size))
+        if not self._handle:
+            raise AsyncIOError("failed to create aio handle")
+        self._inflight: Dict[int, np.ndarray] = {}
+
+    def _buf_ptr(self, arr: np.ndarray):
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("aio buffers must be C-contiguous")
+        return ctypes.cast(arr.ctypes.data, ctypes.c_char_p)
+
+    def pwrite(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        """Async write of the whole buffer; returns a request id."""
+        req = self._lib.ds_aio_pwrite(self._handle, path.encode(),
+                                      self._buf_ptr(arr), arr.nbytes, offset)
+        self._inflight[req] = arr
+        return req
+
+    def pread(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        """Async read filling the whole buffer; returns a request id."""
+        req = self._lib.ds_aio_pread(self._handle, path.encode(),
+                                     self._buf_ptr(arr), arr.nbytes, offset)
+        self._inflight[req] = arr
+        return req
+
+    def wait(self, request_id: int) -> int:
+        """Block until one request completes; returns bytes moved."""
+        rc = self._lib.ds_aio_wait(self._handle, request_id)
+        self._inflight.pop(request_id, None)
+        if rc < 0:
+            raise AsyncIOError(-rc, f"aio request {request_id} failed")
+        return rc
+
+    def wait_all(self) -> None:
+        rc = self._lib.ds_aio_wait_all(self._handle)
+        self._inflight.clear()
+        if rc < 0:
+            raise AsyncIOError(-rc, "aio batch failed")
+
+    # -------- sync conveniences (used by checkpoint/swap fallbacks) ------
+    def sync_pwrite(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        return self.wait(self.pwrite(arr, path, offset))
+
+    def sync_pread(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        return self.wait(self.pread(arr, path, offset))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ds_aio_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

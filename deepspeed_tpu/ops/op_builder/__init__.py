@@ -1,0 +1,13 @@
+"""Native host-op build system (reference ``op_builder/``)."""
+
+from .builder import (  # noqa: F401
+    ALL_OPS,
+    AsyncIOBuilder,
+    CPUAdagradBuilder,
+    CPUAdamBuilder,
+    CPULionBuilder,
+    OpBuilder,
+    OpBuilderError,
+    create_op_builder,
+    get_op_builder,
+)

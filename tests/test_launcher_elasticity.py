@@ -223,6 +223,46 @@ def test_v02_host_granularity():
     assert final % (micro * 4) == 0
 
 
+def test_exclude_validates_slot_indices():
+    res = parse_hostfile("a slots=4")
+    with pytest.raises(ValueError):
+        filter_resources(res, exclude="a:9")
+
+
+def test_v02_no_world_size_returns_full_valid_set():
+    # without a current allocation the degraded fallback must NOT collapse
+    # the valid set to num_gpus_per_node
+    cfg = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 1024,
+        "micro_batch_sizes": [2, 4], "min_gpus": 8, "max_gpus": 64,
+        "num_gpus_per_node": 4, "version": 0.2}}
+    _, valid = compute_elastic_config(cfg)
+    assert len(valid) > 1 and all(v >= 2 for v in valid)
+
+
+def test_v02_min_bound_respected():
+    from deepspeed_tpu.elasticity import ElasticityConfigError
+    # min_gpus=6 with 4-chip hosts: 1 host (4 chips) violates the minimum
+    _, valid_dp, _ = get_compatible_chips_v02(
+        [2], 1024, current_num_chips=0, min_chips=6, max_chips=64,
+        chips_per_host=4)
+    assert all(v * 1 >= 2 for v in valid_dp)  # dp units
+    assert min(valid_dp) * 1 >= 8 // 4 * 4 // 4 * 2  # >= 2 hosts worth
+    with pytest.raises(ElasticityConfigError):
+        get_compatible_chips_v02([2], 1024, current_num_chips=0,
+                                 min_chips=1, max_chips=2, chips_per_host=4)
+
+
+def test_usable_chip_count_respects_mp():
+    from deepspeed_tpu.elasticity import usable_chip_count
+    cfg = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 256,
+        "micro_batch_sizes": [2], "min_gpus": 1, "max_gpus": 64,
+        "num_gpus_per_node": 4, "model_parallel_size": 2, "version": 0.2}}
+    chips = usable_chip_count(cfg, 8)
+    assert chips <= 8 and chips % 2 == 0  # whole mp groups only
+
+
 def test_v02_degraded_fallback():
     # current allocation not in valid set -> keep it, shrink batch
     final, valid_dp, micro = get_compatible_chips_v02(

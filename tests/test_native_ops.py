@@ -1,0 +1,172 @@
+"""Native host-op tests (reference ``tests/unit/ops/{adam,lion,adagrad,aio}``:
+numeric parity of fused native ops vs a pure-numpy reference)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.op_builder import (ALL_OPS, OpBuilderError,
+                                          create_op_builder, get_op_builder)
+
+
+def _numpy_adamw(p, g, m, v, step, lr, b1, b2, eps, wd, adamw, bias_corr):
+    p, g, m, v = (a.astype(np.float64) for a in (p, g, m, v))
+    if not adamw and wd:
+        g = g + wd * p
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    if bias_corr:
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+    else:
+        mhat, vhat = m, v
+    if adamw and wd:
+        p = p * (1 - lr * wd)
+    p = p - lr * mhat / (np.sqrt(vhat) + eps)
+    return (a.astype(np.float32) for a in (p, m, v))
+
+
+def test_builder_registry():
+    assert {"cpu_adam", "cpu_adagrad", "cpu_lion", "async_io"} <= set(ALL_OPS)
+    with pytest.raises(OpBuilderError):
+        get_op_builder("bogus_op")
+    b = create_op_builder("cpu_adam")
+    assert b.is_compatible()
+
+
+def test_builder_cache_reuse():
+    b = create_op_builder("cpu_adam")
+    so1 = b.build()
+    so2 = b.build()
+    assert so1 == so2 and so1.is_file()
+
+
+@pytest.mark.parametrize("adamw", [True, False])
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_cpu_adam_parity(adamw, wd):
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+    rng = np.random.default_rng(0)
+    n = 4099  # odd size exercises the scalar tail past SIMD chunks
+    p = rng.normal(size=n).astype(np.float32)
+    ref_p = p.copy()
+    ref_m = np.zeros(n, np.float32)
+    ref_v = np.zeros(n, np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-2, betas=(0.9, 0.999), eps=1e-8,
+                           weight_decay=wd, adamw_mode=adamw)
+    for step in range(1, 6):
+        g = rng.normal(size=n).astype(np.float32)
+        opt.step(0, p, g)
+        ref_p, ref_m, ref_v = _numpy_adamw(
+            ref_p, g, ref_m, ref_v, step, 1e-2, 0.9, 0.999, 1e-8, wd,
+            adamw, True)
+        np.testing.assert_allclose(p, ref_p, rtol=2e-5, atol=2e-6)
+    st = opt.state_for(0, n)
+    np.testing.assert_allclose(st["exp_avg"], ref_m, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(st["exp_avg_sq"], ref_v, rtol=2e-5, atol=2e-6)
+
+
+def test_cpu_adam_state_roundtrip():
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+    rng = np.random.default_rng(1)
+    n = 257
+    p1 = rng.normal(size=n).astype(np.float32)
+    p2 = p1.copy()
+    g1 = rng.normal(size=(3, n)).astype(np.float32)
+    a = DeepSpeedCPUAdam(lr=1e-3)
+    a.step(0, p1, g1[0])
+    sd = a.state_dict()
+    b = DeepSpeedCPUAdam(lr=1e-3)
+    b.step(0, p2, g1[0])
+    b.load_state_dict(sd)
+    a.step(0, p1, g1[1])
+    b.step(0, p2, g1[1])
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_cpu_adagrad_parity():
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdagrad
+    rng = np.random.default_rng(2)
+    n = 1031
+    p = rng.normal(size=n).astype(np.float32)
+    ref_p = p.astype(np.float64)
+    ref_sq = np.zeros(n, np.float64)
+    opt = DeepSpeedCPUAdagrad(lr=1e-2, eps=1e-10)
+    for _ in range(3):
+        g = rng.normal(size=n).astype(np.float32)
+        opt.step(0, p, g)
+        ref_sq += g.astype(np.float64) ** 2
+        ref_p -= 1e-2 * g / (np.sqrt(ref_sq) + 1e-10)
+    np.testing.assert_allclose(p, ref_p.astype(np.float32), rtol=3e-5,
+                               atol=3e-6)
+
+
+def test_cpu_lion_parity():
+    from deepspeed_tpu.ops.adam import DeepSpeedCPULion
+    rng = np.random.default_rng(3)
+    n = 515
+    p = rng.normal(size=n).astype(np.float32)
+    ref_p = p.copy().astype(np.float64)
+    ref_m = np.zeros(n, np.float64)
+    lr, b1, b2, wd = 1e-3, 0.9, 0.99, 0.1
+    opt = DeepSpeedCPULion(lr=lr, betas=(b1, b2), weight_decay=wd)
+    for _ in range(4):
+        g = rng.normal(size=n).astype(np.float32)
+        opt.step(0, p, g)
+        update = np.sign(b1 * ref_m + (1 - b1) * g)
+        ref_p = ref_p * (1 - lr * wd) - lr * update
+        ref_m = b2 * ref_m + (1 - b2) * g
+    np.testing.assert_allclose(p, ref_p.astype(np.float32), rtol=3e-5,
+                               atol=3e-6)
+
+
+# ------------------------------------------------------------------- aio
+
+def test_aio_roundtrip(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(num_threads=2)
+    buf = np.arange(1 << 18, dtype=np.float32)
+    out = np.zeros_like(buf)
+    path = str(tmp_path / "shard.bin")
+    assert h.sync_pwrite(buf, path) == buf.nbytes
+    assert h.sync_pread(out, path) == buf.nbytes
+    np.testing.assert_array_equal(buf, out)
+    h.close()
+
+
+def test_aio_async_overlap(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(num_threads=4)
+    bufs = [np.full(1 << 16, i, np.float32) for i in range(8)]
+    reqs = [h.pwrite(b, str(tmp_path / f"s{i}.bin"))
+            for i, b in enumerate(bufs)]
+    assert len(set(reqs)) == len(reqs)
+    h.wait_all()
+    outs = [np.zeros(1 << 16, np.float32) for _ in range(8)]
+    for i, o in enumerate(outs):
+        h.pread(o, str(tmp_path / f"s{i}.bin"))
+    h.wait_all()
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, bufs[i])
+    h.close()
+
+
+def test_aio_offset_io(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(num_threads=1)
+    path = str(tmp_path / "o.bin")
+    a = np.arange(100, dtype=np.float32)
+    b = np.arange(100, 200, dtype=np.float32)
+    h.sync_pwrite(a, path, offset=0)
+    h.sync_pwrite(b, path, offset=a.nbytes)
+    out = np.zeros(200, np.float32)
+    h.sync_pread(out, path)
+    np.testing.assert_array_equal(out, np.arange(200, dtype=np.float32))
+    h.close()
+
+
+def test_aio_missing_file_errors(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOError, AsyncIOHandle
+    h = AsyncIOHandle(num_threads=1)
+    out = np.zeros(16, np.float32)
+    with pytest.raises(AsyncIOError):
+        h.sync_pread(out, str(tmp_path / "missing.bin"))
+    h.close()
