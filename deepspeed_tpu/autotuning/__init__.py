@@ -1,0 +1,11 @@
+"""Autotuning (reference ``deepspeed/autotuning/``)."""
+
+from .autotuner import (  # noqa: F401
+    Autotuner,
+    Experiment,
+    GridSearchTuner,
+    ModelBasedTuner,
+    RandomTuner,
+    ResourceManager,
+    zero_memory_per_param,
+)

@@ -1,0 +1,273 @@
+"""Autotuner: search ZeRO stage / micro-batch / offload configs.
+
+TPU-native analogue of ``deepspeed/autotuning/`` (``Autotuner``
+autotuner.py:42, tuning-space construction from model info + device-memory
+heuristics :278, ``GridSearchTuner``/``RandomTuner`` index_based_tuner.py,
+``ModelBasedTuner`` + cost model model_based_tuner.py:19/cost_model.py:14,
+experiment scheduler scheduler.py).  Differences by design:
+
+* the reference launches each experiment as a fresh ``deepspeed`` ssh job;
+  here experiments run **in-process** — an engine is constructed per
+  candidate config on the live mesh (or the CPU virtual mesh in CI) and a
+  few steps are timed.  XLA compilation replaces warmup-profiling runs.
+* the memory pruner uses the ZeRO memory model directly (bytes/param by
+  stage and DP width) plus the compiled executable's reported temp sizes
+  when available.
+* the model-based tuner fits a quadratic throughput model with numpy
+  (XGBoost is not a dependency of this image).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+
+METRIC_THROUGHPUT = "throughput"
+METRIC_LATENCY = "latency"
+
+
+def zero_memory_per_param(stage: int, dp: int, master_fp32: bool = True)\
+        -> float:
+    """Device bytes per parameter under the ZeRO memory model
+    (reference autotuner heuristics; Rajbhandari et al. table):
+    bf16 weights (2) + bf16/fp32 grads (4 accum) + optimizer states
+    (fp32 master 4 + moments 8 = 12), sharded by stage."""
+    weights, grads, opt = 2.0, 4.0, (12.0 if master_fp32 else 8.0)
+    if stage == 0:
+        return weights + grads + opt
+    if stage == 1:
+        return weights + grads + opt / dp
+    if stage == 2:
+        return weights + (grads + opt) / dp
+    return (weights + grads + opt) / dp  # stage 3
+
+
+@dataclass
+class Experiment:
+    config: Dict[str, Any]
+    metrics: Dict[str, float] = field(default_factory=dict)
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and bool(self.metrics)
+
+
+class BaseTuner:
+    """Iterates a tuning space, best-so-far tracking."""
+
+    def __init__(self, space: List[Dict[str, Any]], metric: str):
+        self.space = space
+        self.metric = metric
+        self.results: List[Experiment] = []
+
+    def next_batch(self, n: int) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def record(self, exp: Experiment) -> None:
+        self.results.append(exp)
+
+    def best(self) -> Optional[Experiment]:
+        good = [e for e in self.results if e.ok]
+        if not good:
+            return None
+        if self.metric == METRIC_LATENCY:
+            return min(good, key=lambda e: e.metrics[METRIC_LATENCY])
+        return max(good, key=lambda e: e.metrics[self.metric])
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive in-order sweep (reference index_based_tuner.py:11)."""
+
+    def __init__(self, space, metric):
+        super().__init__(space, metric)
+        self._i = 0
+
+    def next_batch(self, n):
+        batch = self.space[self._i:self._i + n]
+        self._i += len(batch)
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    """Uniform random without replacement (index_based_tuner.py:27)."""
+
+    def __init__(self, space, metric, seed: int = 0):
+        super().__init__(space, metric)
+        self._order = list(space)
+        random.Random(seed).shuffle(self._order)
+        self._i = 0
+
+    def next_batch(self, n):
+        batch = self._order[self._i:self._i + n]
+        self._i += len(batch)
+        return batch
+
+
+class ModelBasedTuner(BaseTuner):
+    """Fit throughput(micro_batch) per stage, explore the predicted best
+    (reference model_based_tuner.py:19 with the XGBoost cost model swapped
+    for a numpy quadratic fit)."""
+
+    def __init__(self, space, metric, seed: int = 0):
+        super().__init__(space, metric)
+        self._tried: set = set()
+        self._rng = random.Random(seed)
+
+    def _key(self, cfg) -> Tuple:
+        return (cfg["zero_stage"], cfg["micro_batch"])
+
+    def _predict(self, cfg) -> float:
+        """Quadratic fit of metric vs log2(micro_batch) within the stage."""
+        pts = [(np.log2(e.config["micro_batch"]), e.metrics[self.metric])
+               for e in self.results
+               if e.ok and e.config["zero_stage"] == cfg["zero_stage"]]
+        if len(pts) < 3:
+            return float("inf")  # insufficient data -> explore
+        x, y = np.array([p[0] for p in pts]), np.array([p[1] for p in pts])
+        coef = np.polyfit(x, y, 2)
+        return float(np.polyval(coef, np.log2(cfg["micro_batch"])))
+
+    def next_batch(self, n):
+        remaining = [c for c in self.space
+                     if self._key(c) not in self._tried]
+        if not remaining:
+            return []
+        scored = sorted(remaining, key=self._predict, reverse=True)
+        batch = scored[:n]
+        self._tried.update(self._key(c) for c in batch)
+        return batch
+
+
+TUNER_CLASSES = {
+    "gridsearch": GridSearchTuner,
+    "random": RandomTuner,
+    "model_based": ModelBasedTuner,
+}
+
+
+class ResourceManager:
+    """Runs experiments (reference autotuning/scheduler.py) — in-process:
+    build an engine for the candidate config, time a few steps, tear down."""
+
+    def __init__(self, model_factory: Callable[[], Any],
+                 data_fn: Callable[[int], Any],
+                 warmup_steps: int = 1, measure_steps: int = 3):
+        self.model_factory = model_factory
+        self.data_fn = data_fn
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+
+    def run(self, ds_config: Dict[str, Any]) -> Experiment:
+        import deepspeed_tpu as dst
+        exp = Experiment(config=dict(ds_config))
+        try:
+            engine, *_ = dst.initialize(model=self.model_factory(),
+                                        config=ds_config["ds_config"])
+            batch = self.data_fn(engine.train_batch_size())
+            for _ in range(self.warmup_steps):
+                engine.train_batch(batch)
+            t0 = time.perf_counter()
+            for _ in range(self.measure_steps):
+                engine.train_batch(batch)
+            dt = (time.perf_counter() - t0) / self.measure_steps
+            exp.metrics = {
+                METRIC_THROUGHPUT: engine.train_batch_size() / dt,
+                METRIC_LATENCY: dt,
+            }
+        except Exception as e:  # OOM / invalid config -> pruned, not fatal
+            exp.error = f"{type(e).__name__}: {e}"
+            logger.info("autotuning experiment failed: %s", exp.error)
+        return exp
+
+
+class Autotuner:
+    """Search driver (reference autotuner.py:42).
+
+    Parameters
+    ----------
+    model_factory: builds a fresh model per experiment.
+    data_fn: ``data_fn(global_batch_size) -> batch`` synthetic batch maker.
+    base_config: DeepSpeed config dict; tuned keys are overridden.
+    num_params: model parameter count (memory pruning).
+    hbm_bytes: per-chip device memory budget; None disables pruning.
+    """
+
+    def __init__(self, model_factory, data_fn, base_config: Dict[str, Any],
+                 num_params: int = 0,
+                 hbm_bytes: Optional[float] = None,
+                 stages: Sequence[int] = (0, 1, 2, 3),
+                 micro_batches: Sequence[int] = (1, 2, 4, 8),
+                 tuner_type: str = "gridsearch",
+                 metric: str = METRIC_THROUGHPUT,
+                 max_trials: int = 64,
+                 dp: int = 1):
+        self.base_config = base_config
+        self.num_params = num_params
+        self.hbm_bytes = hbm_bytes
+        self.stages = list(stages)
+        self.micro_batches = list(micro_batches)
+        self.metric = metric
+        self.max_trials = max_trials
+        self.dp = max(1, dp)
+        self.manager = ResourceManager(model_factory, data_fn)
+        self.tuner_type = tuner_type
+
+    # ---------------------------------------------------------- the space
+    def tuning_space(self) -> List[Dict[str, Any]]:
+        space = []
+        for stage, mb in itertools.product(self.stages, self.micro_batches):
+            if self.hbm_bytes and self.num_params:
+                need = self.num_params * zero_memory_per_param(stage, self.dp)
+                if need > self.hbm_bytes:
+                    continue  # pruned by the ZeRO memory model
+            cfg = json.loads(json.dumps(self.base_config))  # deep copy
+            cfg["train_micro_batch_size_per_gpu"] = mb
+            cfg.setdefault("zero_optimization", {})["stage"] = stage
+            cfg.pop("train_batch_size", None)  # re-derived from mb*gas*dp
+            space.append({"zero_stage": stage, "micro_batch": mb,
+                          "ds_config": cfg})
+        return space
+
+    # ------------------------------------------------------------- tuning
+    def tune(self) -> Tuple[Optional[Dict[str, Any]], List[Experiment]]:
+        space = self.tuning_space()
+        if not space:
+            logger.warning("autotuning space is empty after memory pruning")
+            return None, []
+        tuner_cls = TUNER_CLASSES.get(self.tuner_type)
+        if tuner_cls is None:
+            raise ValueError(f"unknown tuner {self.tuner_type!r}; "
+                             f"options: {sorted(TUNER_CLASSES)}")
+        tuner = tuner_cls(space, self.metric)
+        trials = 0
+        while trials < self.max_trials:
+            batch = tuner.next_batch(1)
+            if not batch:
+                break
+            exp = self.manager.run(batch[0])
+            tuner.record(exp)
+            trials += 1
+            if exp.ok:
+                logger.info("autotune trial stage=%d mb=%d -> %s=%.2f",
+                            batch[0]["zero_stage"], batch[0]["micro_batch"],
+                            self.metric, exp.metrics[self.metric])
+        best = tuner.best()
+        return (best.config if best else None), tuner.results
+
+    def write_results(self, path: str, results: List[Experiment]) -> None:
+        out = [{"config": {k: v for k, v in e.config.items()
+                           if k != "ds_config"},
+                "ds_config": e.config.get("ds_config"),
+                "metrics": e.metrics, "error": e.error}
+               for e in results]
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(out, fh, indent=2)

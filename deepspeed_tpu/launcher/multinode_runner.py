@@ -190,8 +190,16 @@ class SlurmRunner(MultiNodeRunner):
     def get_cmd(self, environment, active_resources):
         per_chip = getattr(self.args, "proc_per_chip", False)
         if per_chip:
+            slot_counts = set(active_resources.values())
+            if len(slot_counts) > 1:
+                # srun's --ntasks-per-node is uniform; heterogeneous slot
+                # filters would land ranks on excluded chips
+                raise ValueError(
+                    "slurm per-chip launch requires a uniform slot count "
+                    f"per host, got {dict(active_resources)}; use the ssh "
+                    "or pdsh launcher for heterogeneous filters")
             total_procs = sum(active_resources.values())
-            tasks_per_node = max(active_resources.values())
+            tasks_per_node = slot_counts.pop()
         else:
             total_procs = len(active_resources)
             tasks_per_node = 1

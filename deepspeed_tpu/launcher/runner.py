@@ -74,17 +74,20 @@ def _resolve_elastic_world(args, resources) -> "OrderedDict[str, int]":
     # per-host processes own ALL local chips, so a partial host cannot be
     # enforced — take the longest whole-host prefix whose chip sum is
     # exactly a valid elastic count
+    from ..elasticity import ElasticityConfig, compute_elastic_config
+    # one solve; prefix sums are then tested against the chip-count set
+    _, valid_dp = compute_elastic_config(ds_config)
+    mp = ElasticityConfig.from_dict(
+        ds_config["elasticity"]).model_parallel_size
+    valid_chips = {v * mp for v in valid_dp}
     hosts = list(resources.items())
     best_k = 0
     prefix = 0
     valid_prefixes = []
     for k, (_, slots) in enumerate(hosts, start=1):
         prefix += slots
-        try:
-            if usable_chip_count(ds_config, prefix) == prefix:
-                valid_prefixes.append(k)
-        except Exception:
-            pass
+        if prefix in valid_chips:
+            valid_prefixes.append(k)
     if not valid_prefixes:
         raise RuntimeError(
             f"no whole-host prefix of {dict(resources)} sums to a valid "

@@ -9,7 +9,7 @@ layer (``runtime/swap_tensor``) builds its param/optimizer swappers on this.
 from __future__ import annotations
 
 import ctypes
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -33,7 +33,8 @@ class AsyncIOHandle:
                                                int(block_size))
         if not self._handle:
             raise AsyncIOError("failed to create aio handle")
-        self._inflight: Dict[int, np.ndarray] = {}
+        # request id -> (buffer keep-alive, expected bytes, is_read)
+        self._inflight: Dict[int, tuple] = {}
 
     def _buf_ptr(self, arr: np.ndarray):
         if not arr.flags["C_CONTIGUOUS"]:
@@ -44,29 +45,41 @@ class AsyncIOHandle:
         """Async write of the whole buffer; returns a request id."""
         req = self._lib.ds_aio_pwrite(self._handle, path.encode(),
                                       self._buf_ptr(arr), arr.nbytes, offset)
-        self._inflight[req] = arr
+        self._inflight[req] = (arr, arr.nbytes, False)
         return req
 
     def pread(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
         """Async read filling the whole buffer; returns a request id."""
         req = self._lib.ds_aio_pread(self._handle, path.encode(),
                                      self._buf_ptr(arr), arr.nbytes, offset)
-        self._inflight[req] = arr
+        self._inflight[req] = (arr, arr.nbytes, True)
         return req
 
     def wait(self, request_id: int) -> int:
         """Block until one request completes; returns bytes moved."""
         rc = self._lib.ds_aio_wait(self._handle, request_id)
-        self._inflight.pop(request_id, None)
+        _, expected, is_read = self._inflight.pop(
+            request_id, (None, None, False))
         if rc < 0:
             raise AsyncIOError(-rc, f"aio request {request_id} failed")
+        if is_read and expected is not None and rc < expected:
+            # EOF short read: a truncated file would leave uninitialized
+            # tail bytes in the destination buffer — surface it
+            raise AsyncIOError(
+                f"short read: got {rc} of {expected} bytes "
+                f"(request {request_id}; truncated or missing file?)")
         return rc
 
     def wait_all(self) -> None:
-        rc = self._lib.ds_aio_wait_all(self._handle)
-        self._inflight.clear()
-        if rc < 0:
-            raise AsyncIOError(-rc, "aio batch failed")
+        """Drain every inflight request (short-read checked per request)."""
+        first_err: Optional[AsyncIOError] = None
+        for req in list(self._inflight):
+            try:
+                self.wait(req)
+            except AsyncIOError as e:
+                first_err = first_err or e
+        if first_err is not None:
+            raise first_err
 
     # -------- sync conveniences (used by checkpoint/swap fallbacks) ------
     def sync_pwrite(self, arr: np.ndarray, path: str, offset: int = 0) -> int:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
+import platform
 import subprocess
 import tempfile
 from pathlib import Path
@@ -72,6 +73,24 @@ class OpBuilder:
         return os.environ.get("CXX", "g++")
 
     # ---------------------------------------------------------------- load
+    _compiler_id_cache: Dict[str, str] = {}
+
+    def _compiler_id(self) -> str:
+        """Compiler version + host CPU: -march=native binaries are host-
+        specific, so a shared cache dir must never serve a mismatched .so
+        (SIGILL on an older CPU)."""
+        cxx = self.compiler()
+        cached = OpBuilder._compiler_id_cache.get(cxx)
+        if cached is None:
+            try:
+                ver = subprocess.run([cxx, "--version"], capture_output=True,
+                                     text=True).stdout.splitlines()[0]
+            except Exception:
+                ver = "unknown"
+            cached = ver + "|" + platform.processor() + platform.machine()
+            OpBuilder._compiler_id_cache[cxx] = cached
+        return cached
+
     def _hash(self) -> str:
         h = hashlib.sha256()
         for src in self.absolute_sources():
@@ -80,6 +99,7 @@ class OpBuilder:
             for header in sorted(inc_dir.glob("*.h")):
                 h.update(header.read_bytes())
         h.update(" ".join(self.cxx_args()).encode())
+        h.update(self._compiler_id().encode())
         return h.hexdigest()[:16]
 
     def so_path(self) -> Path:

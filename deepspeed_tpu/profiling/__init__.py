@@ -1,0 +1,8 @@
+"""Profiling (reference ``deepspeed/profiling/``)."""
+
+from .flops_profiler import (  # noqa: F401
+    FlopsProfiler,
+    compiled_cost,
+    count_params,
+    get_model_profile,
+)

@@ -45,6 +45,7 @@ class OptimizerParams(DeepSpeedConfigModel):
     weight_decay: float = 0.0
     momentum: float = 0.0  # sgd
     bias_correction: bool = True
+    adam_w_mode: bool = True  # FusedAdam default: decoupled decay
 
 
 class OptimizerConfig(DeepSpeedConfigModel):
@@ -90,6 +91,7 @@ class OffloadConfig(DeepSpeedConfigModel):
     pipeline_write: bool = False
     fast_init: bool = False
     ratio: float = 1.0  # ZeRO-Offload++ partial offload (engine.py:766)
+    aio_threads: int = 4  # NVMe swapper I/O thread pool size
 
 
 class ZeroConfig(DeepSpeedConfigModel):
@@ -253,6 +255,17 @@ class DataEfficiencyConfig(DeepSpeedConfigModel):
     data_routing: Dict[str, Any] = Field(default_factory=dict)
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """``hybrid_engine`` section (reference runtime/hybrid_engine.py config:
+    enable RLHF train+generate mode)."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True  # accepted; XLA manages placement
+    tp_gather_partition_size: int = 8  # accepted; GSPMD handles gathers
+
+
 class TPUConfig(DeepSpeedConfigModel):
     """TPU-native extension knobs (no reference analogue)."""
     # Mesh axis sizes; -1 = absorb remaining devices.
@@ -306,6 +319,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
     compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+    hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
     tpu: TPUConfig = Field(default_factory=TPUConfig)
 
     # ------------------------------------------------------------------

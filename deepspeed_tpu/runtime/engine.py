@@ -172,6 +172,7 @@ class DeepSpeedEngine:
         self.lr_scheduler = LRScheduler(self._schedule)
         self.optimizer = self._build_optimizer(opt_cfg)
         self.basic_optimizer = self.optimizer
+        self.offload: Optional[Any] = None  # set in _maybe_enable_offload
 
         # -- state init ----------------------------------------------------
         if params is not None:
@@ -181,6 +182,11 @@ class DeepSpeedEngine:
             init_params = params
         else:
             init_params = self._init_params()  # sets self._abstract_params
+        self._maybe_enable_offload()
+        if self.offload is not None:
+            # masters come from the fp32 initializer output, BEFORE the
+            # device copy is narrowed to compute dtype
+            self.offload.init_masters(unbox(init_params))
         self.state = self._init_state(init_params)
         self.global_steps = 0
         self.micro_steps = 0
@@ -218,6 +224,24 @@ class DeepSpeedEngine:
         return get_optimizer(opt_cfg.type, opt_cfg.params,
                              lr_schedule=lambda count: self._traced_lr(count))
 
+    def _maybe_enable_offload(self) -> None:
+        """ZeRO-Offload: mask offloaded leaves out of the device optimizer
+        and hand them to the host C++ path (runtime/zero/offload.py)."""
+        off = self.config.zero_optimization.offload_optimizer
+        if off.device in (None, "none"):
+            return
+        from .zero.offload import HostOffloadOptimizer
+        unboxed_abstract = jax.eval_shape(unbox, self._abstract_params)
+        self.offload = HostOffloadOptimizer(unboxed_abstract, self.config)
+        mask = self.offload.device_mask()
+        inv_mask = jax.tree.map(lambda m: not m, mask)
+        # masked() passes untouched leaves' updates through VERBATIM, so the
+        # offloaded leaves' raw grads must be zeroed or apply_updates would
+        # do SGD on them behind the host optimizer's back
+        self.optimizer = optax.chain(
+            optax.masked(self.optimizer, mask),
+            optax.masked(optax.set_to_zero(), inv_mask))
+
     def _traced_lr(self, count):
         sched = self._schedule
         try:
@@ -244,8 +268,20 @@ class DeepSpeedEngine:
 
     def _init_state(self, params) -> TrainState:
         params = unbox(params)
-        params = jax.tree.map(lambda x: x.astype(self.master_dtype)
-                              if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        if self.offload is not None:
+            # fp32 master of offloaded leaves lives on the HOST; the device
+            # keeps only the compute-dtype copy (the offload memory win)
+            offloaded = set(self.offload.offload_idx)
+            flat, treedef = jax.tree.flatten(params)
+            flat = [x.astype(self.compute_dtype
+                             if i in offloaded else self.master_dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x
+                    for i, x in enumerate(flat)]
+            params = jax.tree.unflatten(treedef, flat)
+        else:
+            params = jax.tree.map(
+                lambda x: x.astype(self.master_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         # Specs computed from the boxed abstract tree (keeps logical axes);
         # its Partitioned nodes sit exactly where unboxed array leaves sit,
         # so the resulting sharding tree matches the unboxed param treedef.
@@ -276,11 +312,21 @@ class DeepSpeedEngine:
         rep = NamedSharding(mesh, P())
         # Optimizer moments mirror the param tree inside optax state
         # namedtuples; tree_map_params pairs them with master shardings.
+        # Offloaded leaves have MaskedNode (no device moments): their
+        # sharding slot must be a matching empty container, not a leaf.
+        if self.offload is not None:
+            offloaded = set(self.offload.offload_idx)
+            flat_sh, sh_treedef = jax.tree.flatten(master_sh)
+            flat_sh = [optax.MaskedNode() if i in offloaded else s
+                       for i, s in enumerate(flat_sh)]
+            master_sh_for_opt = jax.tree.unflatten(sh_treedef, flat_sh)
+        else:
+            master_sh_for_opt = master_sh
         opt_sh = optax.tree_map_params(
             self.optimizer,
             lambda _leaf, sh: sh,
             abstract_state.opt_state,
-            master_sh,
+            master_sh_for_opt,
             transform_non_params=lambda _leaf: rep)
         return TrainState(
             step=rep,
@@ -425,9 +471,22 @@ class DeepSpeedEngine:
                 "loss": jnp.mean(losses).astype(jnp.float32),
                 "grad_norm": gnorm,
                 "lr": jnp.asarray(self._traced_lr(state.step), jnp.float32),
+                # lr at the APPLIED-update count: optax's schedule counter
+                # only advances on non-skipped steps, and the host offload
+                # optimizer must see the identical lr or offloaded leaves
+                # drift off-schedule after any fp16 overflow
+                "applied_lr": jnp.asarray(
+                    self._traced_lr(state.step - state.skipped_steps),
+                    jnp.float32),
                 "overflow": (~finite).astype(jnp.int32),
             }
-            return new_state, metrics
+            if self.offload is not None:
+                # ship reduced+clipped fp32 grads of offloaded leaves to the
+                # host optimizer
+                flat_grads = jax.tree.leaves(grads)
+                off_grads = [flat_grads[i] for i in self.offload.offload_idx]
+                return new_state, metrics, off_grads
+            return new_state, metrics, ()
 
         state_sh = self._state_shardings_cache
         donate = (0,) if cfg.tpu.donate_state else ()
@@ -435,7 +494,7 @@ class DeepSpeedEngine:
         # device_put with explicit shardings in train_batch and jit inherits
         # them (in_shardings left unspecified for that arg).
         return jax.jit(step_fn,
-                       out_shardings=(state_sh, None),
+                       out_shardings=(state_sh, None, None),
                        donate_argnums=donate)
 
     def _batch_leaf_sharding(self, leaf, microbatched: bool) -> NamedSharding:
@@ -546,7 +605,16 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         with self.topology.mesh:
             batch = self._place_batch(batch, microbatched=True)
-            self.state, metrics = self._train_step(self.state, batch, self._next_rng())
+            self._maybe_profile_flops(batch)
+            self.state, metrics, off_grads = self._train_step(
+                self.state, batch, self._next_rng())
+            # overflow skip exists only under fp16 loss scaling — the
+            # device path updates unconditionally in bf16 mode, and the
+            # host must mirror it exactly or the two halves desync
+            if self.offload is not None and not (
+                    self.fp16_enabled and int(metrics["overflow"])):
+                self._apply_offload_step(off_grads,
+                                         float(metrics["applied_lr"]))
         loss = float(metrics["loss"])
         self._last_grad_norm = float(metrics["grad_norm"])
         self.global_steps += 1
@@ -563,6 +631,55 @@ class DeepSpeedEngine:
                 self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([TRAIN_BATCH_TIMER])
         return loss
+
+    def _maybe_profile_flops(self, placed_batch) -> None:
+        """Print the flops-profiler report at the configured step
+        (reference engine.py:1858/:2193 profile_step integration)."""
+        fp_cfg = self.config.flops_profiler
+        if not fp_cfg.enabled or self.global_steps != fp_cfg.profile_step:
+            return
+        from ..profiling import FlopsProfiler
+        prof = FlopsProfiler(params=self.state.params)
+        # fixed key: lowering must not consume the training RNG stream, or
+        # enabling the profiler changes every later step's randomness
+        lowered = self._train_step.lower(self.state, placed_batch,
+                                         jax.random.key(0))
+        cost = lowered.compile().cost_analysis() or {}
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        prof._cost = {"flops": float(cost.get("flops", 0.0)),
+                      "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
+        prof._duration = self.tput_timer.avg_step_time() \
+            if hasattr(self.tput_timer, "avg_step_time") else 0.0
+        prof.print_model_profile(
+            profile_step=self.global_steps,
+            module_depth=fp_cfg.module_depth,
+            top_modules=fp_cfg.top_modules,
+            detailed=fp_cfg.detailed,
+            output_file=fp_cfg.output_file)
+
+    def _apply_offload_step(self, off_grads, lr: float) -> None:
+        """Host optimizer step over offloaded leaves + push updated weights
+        back to the device (ZeRO-Offload hot path)."""
+        host_grads = jax.device_get(list(off_grads))
+        updated = self.offload.step(
+            [np.asarray(g, np.float32) for g in host_grads], lr=lr)
+        flat, treedef = jax.tree.flatten(self.state.params)
+        if not hasattr(self, "_offload_leaf_shardings"):
+            flat_sh = jax.tree.leaves(
+                self.partitioner.master_shardings(self._abstract_params))
+            self._offload_leaf_shardings = [
+                flat_sh[i] if isinstance(flat_sh[i], NamedSharding)
+                else NamedSharding(self.topology.mesh, flat_sh[i])
+                for i in self.offload.offload_idx]
+        arrays = [
+            updated[k].reshape(flat[i].shape).astype(flat[i].dtype)
+            for k, i in enumerate(self.offload.offload_idx)]
+        placed = jax.device_put(arrays, self._offload_leaf_shardings)
+        for k, i in enumerate(self.offload.offload_idx):
+            flat[i] = placed[k]
+        self.state = self.state.replace(
+            params=jax.tree.unflatten(treedef, flat))
 
     # --- imperative-compat API ----------------------------------------
     def forward(self, batch) -> float:
@@ -624,6 +741,10 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict(),
         })
         self.checkpoint_engine.save(save_dir, tag, self.state, client_state)
+        if self.offload is not None:
+            os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
+            self.offload.save_npz(os.path.join(
+                save_dir, tag, f"offload_rank{jax.process_index()}.npz"))
         if save_latest:
             self.checkpoint_engine.write_latest(save_dir, tag)
         return True
@@ -639,6 +760,18 @@ class DeepSpeedEngine:
             load_dir, tag, self.state, self._state_shardings_cache,
             module_only=load_module_only or not load_optimizer_states)
         self.state = state
+        if self.offload is not None:
+            off_path = os.path.join(
+                load_dir, tag, f"offload_rank{jax.process_index()}.npz")
+            if load_optimizer_states and not load_module_only \
+                    and os.path.exists(off_path):
+                self.offload.load_npz(off_path)
+            else:
+                # no host-state file for this checkpoint (module-only load,
+                # or saved without offload): masters MUST re-sync from the
+                # restored device params, else the next step would push
+                # init-era masters back over the loaded weights
+                self.offload.init_masters(self.state.params)
         self.global_steps = client_state.get("global_steps", 0)
         self.global_samples = client_state.get("global_samples", 0)
         self.micro_steps = client_state.get("micro_steps", 0)

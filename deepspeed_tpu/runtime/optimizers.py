@@ -47,9 +47,16 @@ def get_optimizer(name: str,
     wd = params_cfg.weight_decay
 
     if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM, "deepspeedcpuadam"):
-        # torch.optim.Adam applies decoupled=False L2; DeepSpeed's FusedAdam
-        # defaults to adam_w_mode=True -> adamw semantics.
-        return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+        # DeepSpeed's FusedAdam defaults to adam_w_mode=True -> adamw
+        # semantics; adam_w_mode=False selects coupled L2 (decay folded into
+        # the grad before the moments — classic Adam+L2).
+        if getattr(params_cfg, "adam_w_mode", True):
+            return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps,
+                               weight_decay=wd)
+        return optax.chain(
+            optax.add_decayed_weights(wd) if wd else optax.identity(),
+            optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps),
+            optax.scale_by_learning_rate(lr))
     if name == ADAMW_OPTIMIZER:
         return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
     if name in (LAMB_OPTIMIZER, FUSED_LAMB):

@@ -163,6 +163,18 @@ def test_aio_offset_io(tmp_path):
     h.close()
 
 
+def test_aio_short_read_raises(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOError, AsyncIOHandle
+    h = AsyncIOHandle(num_threads=1)
+    path = str(tmp_path / "trunc.bin")
+    small = np.arange(8, dtype=np.float32)
+    h.sync_pwrite(small, path)
+    big = np.zeros(64, np.float32)
+    with pytest.raises(AsyncIOError, match="short read"):
+        h.sync_pread(big, path)
+    h.close()
+
+
 def test_aio_missing_file_errors(tmp_path):
     from deepspeed_tpu.ops.aio import AsyncIOError, AsyncIOHandle
     h = AsyncIOHandle(num_threads=1)

@@ -1,0 +1,62 @@
+"""Flops profiler tests (reference ``tests/unit/profiling/flops_profiler``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models.base import SimpleModel
+from deepspeed_tpu.profiling import (FlopsProfiler, compiled_cost,
+                                     count_params, get_model_profile)
+
+
+def test_compiled_cost_counts_matmul_flops():
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 64), jnp.float32)
+    cost = compiled_cost(lambda x, y: x @ y, a, b)
+    # dense matmul: 2*M*N*K flops
+    assert cost["flops"] >= 2 * 128 * 256 * 64 * 0.9
+    assert cost["bytes_accessed"] > 0
+
+
+def test_count_params():
+    params = {"w": np.zeros((10, 4)), "b": np.zeros((4,))}
+    assert count_params(params) == 44
+
+
+def test_profiler_summary_and_report(capsys):
+    a = jnp.ones((64, 64), jnp.float32)
+    prof = FlopsProfiler(params={"a": a})
+    s = prof.profile(lambda x: x @ x, a, repeats=2)
+    assert s["flops"] > 0 and s["duration_s"] > 0
+    assert s["flops_per_s"] > 0
+    report = prof.print_model_profile(profile_step=3)
+    out = capsys.readouterr().out
+    assert "Flops Profiler" in report and "step 3" in report
+    assert "params" in out
+
+
+def test_get_model_profile_strings():
+    a = jnp.ones((32, 32), jnp.float32)
+    flops, macs, params = get_model_profile(
+        lambda x: x @ x, args=(a,), params={"a": a},
+        print_profile=False, as_string=True)
+    assert "FLOPs" in flops and "MACs" in macs
+
+
+def test_engine_profile_step_prints(capsys):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "flops_profiler": {"enabled": True, "profile_step": 1},
+        "checkpoint": {"async_save": False},
+    }
+    engine, *_ = dst.initialize(model=SimpleModel(16), config=cfg)
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(32, 16)).astype(np.float32),
+             "y": rng.normal(size=(32, 16)).astype(np.float32)}
+    engine.train_batch(batch)  # step 0 -> global_steps 1
+    engine.train_batch(batch)  # profiled at profile_step=1
+    out = capsys.readouterr().out
+    assert "Flops Profiler" in out
+    assert "fwd+bwd+step flops" in out
