@@ -39,6 +39,7 @@ def initialize(args=None,
     if args is not None and config is None:
         config = getattr(args, "deepspeed_config", None)
 
+    from .runtime.config import load_config
     from .runtime.pipe.module import PipelineModule
     if isinstance(model, PipelineModule):
         try:
@@ -51,6 +52,14 @@ def initialize(args=None,
                                 lr_scheduler=lr_scheduler,
                                 collate_fn=collate_fn,
                                 params=model_parameters, **kwargs)
+    elif load_config(config).hybrid_engine.enabled:
+        # reference engine selection (__init__.py:166): HybridEngine first
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine = DeepSpeedHybridEngine(model=model, config=config,
+                                       training_data=training_data,
+                                       lr_scheduler=lr_scheduler,
+                                       collate_fn=collate_fn,
+                                       params=model_parameters, **kwargs)
     else:
         engine = DeepSpeedEngine(model=model, config=config,
                                  training_data=training_data,

@@ -1,0 +1,184 @@
+"""Inference engine v1 (``deepspeed.init_inference`` path).
+
+TPU-native analogue of ``deepspeed/inference/engine.py:40``
+``InferenceEngine``: wrap a HF model (or our functional CausalLM) for
+TP-sharded inference with kernel injection and a guarded ``generate()``.
+
+Mapping of the reference mechanics:
+
+* policy/kernel injection (``replace_transformer_layer``) → resolve an
+  :mod:`~deepspeed_tpu.module_inject.policies` policy, load weights into
+  the fused functional transformer (flash attention + fused norms);
+* AutoTP sharding (``module_inject/auto_tp.py``) → logical-axis
+  PartitionSpecs placed over the 'tensor' mesh axis (see
+  :class:`~deepspeed_tpu.module_inject.AutoTP` and the equivalent boxed-
+  param path inside ``inference/v2/model.py``);
+* CUDA-graph capture (``_create_cuda_graph`` :519) → jax.jit compilation
+  cache (one executable per shape bucket — XLA *is* the graph);
+* generation itself runs on the v2 ragged engine (paged KV, continuous
+  batching) — one stack serves both APIs, the way FastGen supersedes the
+  v1 kernels in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+from .v2.config import RaggedInferenceEngineConfig
+from .v2.engine import InferenceEngineV2
+from .v2.model import RaggedInferenceModel
+from .v2.sampling import SamplingParams
+from .v2.scheduler import FastGenScheduler, generate as _ragged_generate
+
+try:  # pydantic model (same config_utils as the runtime configs)
+    from ..runtime.config import DeepSpeedConfigModel
+except Exception:  # pragma: no cover
+    DeepSpeedConfigModel = object
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class InferenceConfig(DeepSpeedConfigModel):
+    """Reference ``inference/config.py`` DeepSpeedInferenceConfig (the
+    keys the v1 engine honors; unknown keys warn, matching the
+    accept+warn posture for config compatibility)."""
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = None  # type: ignore[assignment]
+    replace_with_kernel_inject: bool = False
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_tokens_per_batch: int = 2048
+    kv_cache_pages: Optional[int] = None
+    enable_cuda_graph: bool = False  # accepted; XLA always compiles
+
+    def __init__(self, **data):
+        if DeepSpeedConfigModel is object:
+            raise RuntimeError("pydantic config base unavailable")
+        if data.get("tensor_parallel") is None:
+            data["tensor_parallel"] = {}
+        super().__init__(**data)
+
+
+DTYPES = {"float32": jnp.float32, "fp32": jnp.float32,
+          "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+          "float16": jnp.bfloat16, "fp16": jnp.bfloat16}  # fp16→bf16 on TPU
+
+
+class InferenceEngine:
+    """v1 engine: TP-sharded generate()/forward() over one model."""
+
+    def __init__(self, model: Any = None, config: Any = None, **kwargs):
+        if isinstance(config, InferenceConfig):
+            self.config = config
+        else:
+            cfg_dict = dict(config or {})
+            cfg_dict.update(kwargs)
+            known = set(getattr(InferenceConfig, "model_fields", {}))
+            unknown = [k for k in cfg_dict if known and k not in known]
+            for k in unknown:
+                logger.warning("init_inference: ignoring config key %r", k)
+                cfg_dict.pop(k)
+            self.config = InferenceConfig(**cfg_dict)
+        dtype = DTYPES[self.config.dtype.lower()]
+
+        tp = max(1, self.config.tensor_parallel.tp_size)
+        n_dev = len(jax.devices())
+        if tp > n_dev:
+            raise ValueError(f"tp_size {tp} exceeds {n_dev} devices")
+        self.mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:tp]).reshape((tp,)), ("tensor",))
+
+        # ---- module injection: policy -> (cfg, params) ------------------
+        from ..models.transformer import CausalLM, TransformerConfig
+        if isinstance(model, tuple) and len(model) == 2:
+            tcfg, params = model  # pre-loaded (cfg, params)
+        elif isinstance(model, CausalLM):
+            tcfg, params = model.cfg, model.init_params(jax.random.key(0))
+        else:
+            from ..checkpoint.hf import from_pretrained
+            tcfg, params = from_pretrained(model, dtype=dtype)
+        if tcfg.dtype != dtype:  # frozen dataclass: replace, don't mutate
+            import dataclasses as _dc
+            tcfg = _dc.replace(tcfg, dtype=dtype)
+        self.module_config = tcfg
+
+        kv_pages = self.config.kv_cache_pages
+        self._model = RaggedInferenceModel(tcfg, params, mesh=self.mesh)
+        v2cfg = RaggedInferenceEngineConfig()
+        if kv_pages:
+            v2cfg.kv_cache.num_pages = kv_pages
+        self._engine = InferenceEngineV2(self._model, v2cfg)
+        self.module = self._model  # reference attr name
+        log_dist(f"init_inference: tp={tp} dtype={self.config.dtype} "
+                 f"layers={tcfg.num_layers} heads={tcfg.num_heads}",
+                 ranks=[0])
+
+    # ------------------------------------------------------------ forward
+    def forward(self, input_ids, attention_mask=None) -> jax.Array:
+        """Dense logits [B, S, V] (HF-style forward for scoring)."""
+        from ..models import transformer as T
+        input_ids = jnp.asarray(input_ids)
+        if input_ids.ndim == 1:
+            input_ids = input_ids[None]
+        params = self._model.params
+        return T.forward(self._model.cfg, params, input_ids,
+                         attention_mask=attention_mask)
+
+    __call__ = forward
+
+    # ----------------------------------------------------------- generate
+    def generate(self,
+                 input_ids: Union[Sequence[Sequence[int]], Any],
+                 max_new_tokens: int = 64,
+                 max_length: Optional[int] = None,
+                 do_sample: bool = False,
+                 temperature: float = 1.0,
+                 top_k: int = 0,
+                 top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 **ignored) -> List[List[int]]:
+        """Batch generation (reference ``InferenceEngine.generate`` :609
+        guard rails: bounded output length, input validation)."""
+        prompts = self._normalize_prompts(input_ids)
+        if max_length is not None:
+            max_new_tokens = max(self.config.min_out_tokens,
+                                 max_length - min(len(p) for p in prompts))
+        if max_new_tokens > self.config.max_out_tokens:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} exceeds engine "
+                f"max_out_tokens {self.config.max_out_tokens}")
+        params = SamplingParams(
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature) if do_sample else 0.0,
+            top_k=int(top_k), top_p=float(top_p),
+            stop_token=eos_token_id)
+        outs = _ragged_generate(self._engine, prompts, params,
+                                token_budget=self.config.max_tokens_per_batch)
+        return outs
+
+    @staticmethod
+    def _normalize_prompts(input_ids) -> List[List[int]]:
+        arr = np.asarray(input_ids, dtype=object) \
+            if isinstance(input_ids, (list, tuple)) else np.asarray(input_ids)
+        if arr.dtype != object and arr.ndim == 1:
+            return [list(map(int, arr))]
+        if arr.dtype != object and arr.ndim == 2:
+            return [list(map(int, row)) for row in arr]
+        return [list(map(int, p)) for p in input_ids]
+
+    # ------------------------------------------------------- profiling API
+    def profile_model_time(self, use_cuda_events: bool = False):
+        """Reference ``profile_model_time`` (inference/engine.py:195)."""
+        self._profile = True
+
+    def flush(self) -> None:
+        for uid in list(self._engine.state_manager._seqs):
+            self._engine.flush(uid)

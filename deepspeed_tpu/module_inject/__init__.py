@@ -1,0 +1,10 @@
+"""Module injection / AutoTP (reference ``deepspeed/module_inject/``)."""
+
+from .auto_tp import AutoTP, classify  # noqa: F401
+from .policies import (  # noqa: F401
+    GPT2Policy,
+    InjectionPolicy,
+    LlamaPolicy,
+    register_policy,
+    replace_policy_for,
+)

@@ -1,0 +1,82 @@
+"""Per-architecture injection policies.
+
+TPU-native analogue of ``deepspeed/module_inject/replace_policy.py`` +
+``module_inject/containers/`` (policy classes per arch: llama, llama2,
+bloom, gptj, gptneox, opt, bert, megatron, internlm, clip...).  A policy
+resolves a HuggingFace architecture to:
+
+* a :class:`~deepspeed_tpu.models.transformer.TransformerConfig`,
+* a weight-loading function (HF state_dict -> our param tree),
+* which makes "kernel injection" implicit — the functional transformer
+  already runs the fused TPU ops (flash attention, fused RMSNorm, RoPE)
+  that the reference's ``DeepSpeedTransformerInference`` containers swap in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..checkpoint import hf as hf_ckpt
+from ..utils.logging import logger
+
+
+class InjectionPolicy:
+    """Base policy (reference ``DSPolicy``/``TransformerPolicy``)."""
+
+    #: HF ``model_type`` strings this policy claims
+    MODEL_TYPES: Tuple[str, ...] = ()
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg) -> Any:
+        raise NotImplementedError
+
+    @classmethod
+    def load(cls, state_dict: Dict[str, Any], cfg, dtype) -> Any:
+        raise NotImplementedError
+
+
+class LlamaPolicy(InjectionPolicy):
+    """Llama/Llama-2/Mistral/Qwen-family (reference containers/llama.py,
+    llama2.py; mistral/qwen share the rotary+GQA+SwiGLU shape)."""
+    MODEL_TYPES = ("llama", "mistral", "qwen2", "qwen")
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.llama_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_llama(state_dict, cfg, dtype=dtype)
+
+
+class GPT2Policy(InjectionPolicy):
+    """GPT-2 family (reference containers/gpt2.py, distil_bert-style
+    learned-position models load the same way)."""
+    MODEL_TYPES = ("gpt2",)
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.gpt2_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_gpt2(state_dict, cfg, dtype=dtype)
+
+
+_POLICIES = [LlamaPolicy, GPT2Policy]
+
+
+def replace_policy_for(model_type: str) -> InjectionPolicy:
+    """Resolve arch -> policy (reference ``replace_policy`` registry)."""
+    for pol in _POLICIES:
+        if model_type.lower() in pol.MODEL_TYPES:
+            return pol
+    raise ValueError(
+        f"no injection policy for architecture {model_type!r}; supported: "
+        f"{sorted(t for p in _POLICIES for t in p.MODEL_TYPES)}")
+
+
+def register_policy(policy: type) -> None:
+    """Register a custom policy class (reference ``injection_policy`` arg
+    of ``init_inference``)."""
+    _POLICIES.insert(0, policy)

@@ -1,0 +1,154 @@
+"""Inference v1 + AutoTP + hybrid engine tests (reference
+``tests/unit/inference/test_inference.py``, module_inject suites,
+``tests/hybrid_engine/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+from deepspeed_tpu.module_inject import (AutoTP, classify,
+                                         replace_policy_for)
+
+
+def _tiny_model():
+    model_def = LlamaForCausalLM("debug", max_seq_len=256, dtype=jnp.float32)
+    params = meta.unbox(model_def.init_params(jax.random.key(0)))
+    return model_def, params
+
+
+# --------------------------------------------------------------- AutoTP
+
+def test_classify_patterns():
+    assert classify("model.layers.0.self_attn.q_proj.weight") == "column"
+    assert classify("model.layers.0.mlp.gate_proj.weight") == "column"
+    assert classify("model.layers.0.self_attn.o_proj.weight") == "row"
+    assert classify("model.layers.0.mlp.down_proj.weight") == "row"
+    assert classify("transformer.h.0.mlp.c_fc.weight") == "column"
+    assert classify("model.embed_tokens.weight") == "embed"
+    assert classify("model.norm.weight") is None
+
+
+def test_tp_parser_shards_divisible_dims():
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("tensor",))
+    tp = AutoTP(mesh)
+    params = {
+        "layers": {"0": {
+            "q_proj": np.zeros((16, 32), np.float32),   # col: out dim 32
+            "o_proj": np.zeros((32, 16), np.float32),   # row: in dim 32
+            "odd_q_proj": np.zeros((16, 30), np.float32),  # 30 % 4 != 0
+            "norm": np.zeros((16,), np.float32),
+        }},
+        "embed_tokens": np.zeros((64, 16), np.float32),
+    }
+    specs = tp.tp_parser(params)
+    assert specs["layers"]["0"]["q_proj"] == P(None, "tensor")
+    assert specs["layers"]["0"]["o_proj"] == P("tensor", None)
+    assert specs["layers"]["0"]["odd_q_proj"] == P()  # indivisible: replicated
+    assert specs["layers"]["0"]["norm"] == P()
+    assert specs["embed_tokens"] == P("tensor", None)  # vocab sharded
+
+
+def test_autotp_shard_places_on_mesh():
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("tensor",))
+    tp = AutoTP(mesh)
+    params = {"q_proj": np.ones((8, 16), np.float32)}
+    sharded = tp.shard(params)
+    shard_shapes = {s.data.shape for s in sharded["q_proj"].addressable_shards}
+    assert shard_shapes == {(8, 4)}
+
+
+def test_policy_resolution():
+    assert replace_policy_for("llama").__name__ == "LlamaPolicy"
+    assert replace_policy_for("mistral").__name__ == "LlamaPolicy"
+    assert replace_policy_for("gpt2").__name__ == "GPT2Policy"
+    with pytest.raises(ValueError):
+        replace_policy_for("mamba")
+
+
+# ------------------------------------------------------------ v1 engine
+
+def test_init_inference_generate_and_forward():
+    model_def, params = _tiny_model()
+    engine = dst.init_inference(
+        model=(model_def.cfg, params),
+        config={"dtype": "float32", "tensor_parallel": {"tp_size": 2},
+                "max_out_tokens": 64})
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 128, n).tolist() for n in (9, 5)]
+    outs = engine.generate(prompts, max_new_tokens=4)
+    assert len(outs) == 2 and all(len(o) == 4 for o in outs)
+    # forward returns dense logits
+    logits = engine.forward(np.asarray([prompts[0]], np.int32))
+    assert logits.shape == (1, 9, model_def.cfg.vocab_size)
+    # greedy generate continues the argmax chain of forward
+    nxt = int(np.argmax(np.asarray(logits)[0, -1]))
+    assert outs[0][0] == nxt
+
+
+def test_init_inference_guard_rails():
+    model_def, params = _tiny_model()
+    engine = dst.init_inference(model=(model_def.cfg, params),
+                                config={"dtype": "float32",
+                                        "max_out_tokens": 8})
+    with pytest.raises(ValueError):
+        engine.generate([[1, 2, 3]], max_new_tokens=100)
+    big_tp = {"dtype": "float32", "tensor_parallel": {"tp_size": 4096}}
+    with pytest.raises(ValueError):
+        dst.init_inference(model=(model_def.cfg, params), config=big_tp)
+
+
+def test_init_inference_unknown_keys_warn_not_fail():
+    model_def, params = _tiny_model()
+    engine = dst.init_inference(
+        model=(model_def.cfg, params),
+        config={"dtype": "float32", "mp_size": 1})  # legacy key
+    assert engine is not None
+
+
+# --------------------------------------------------------- hybrid engine
+
+HYBRID_CFG = {
+    "train_micro_batch_size_per_gpu": 2,
+    "gradient_accumulation_steps": 1,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    "hybrid_engine": {"enabled": True},
+    "tpu": {"mesh": {"data": -1}, "compute_dtype": "float32",
+            "param_dtype": "float32"},
+    "bf16": {"enabled": False},
+    "checkpoint": {"async_save": False},
+}
+
+
+def _lm_batch(model_def, bs, seq):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, size=(bs, seq + 1))
+    return {"input_ids": ids[:, :-1].astype(np.int32),
+            "labels": ids[:, 1:].astype(np.int32)}
+
+
+def test_hybrid_engine_train_and_generate():
+    model_def = LlamaForCausalLM("debug", max_seq_len=256, dtype=jnp.float32)
+    engine, *_ = dst.initialize(model=model_def, config=HYBRID_CFG)
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+    assert isinstance(engine, DeepSpeedHybridEngine)
+
+    prompts = [[1, 2, 3, 4], [7, 8]]
+    out_before = engine.generate(prompts, max_new_tokens=3, do_sample=False)
+    assert all(len(o) == 3 for o in out_before)
+
+    batch = _lm_batch(model_def, 16, 16)
+    l0 = engine.train_batch(batch)
+    for _ in range(3):
+        l1 = engine.train_batch(batch)
+    assert l1 < l0
+
+    out_after = engine.generate(prompts, max_new_tokens=3, do_sample=False)
+    assert all(len(o) == 3 for o in out_after)
+    # rollouts must reflect the UPDATED weights (cache invalidation)
+    assert engine._inference_params_step == engine.global_steps
+    assert engine.generate_throughput() > 0
