@@ -1,5 +1,6 @@
 from .engine import CheckpointEngine, OrbaxCheckpointEngine
 from .hf import from_pretrained, load_gpt2, load_llama
+from .universal import ds_to_universal, load_universal_into_engine
 from .zero_to_fp32 import (convert_zero_checkpoint_to_fp32_state_dict,
                            flatten_state_dict,
                            get_fp32_state_dict_from_zero_checkpoint)
@@ -9,4 +10,5 @@ __all__ = [
     "load_gpt2", "load_llama",
     "convert_zero_checkpoint_to_fp32_state_dict", "flatten_state_dict",
     "get_fp32_state_dict_from_zero_checkpoint",
+    "ds_to_universal", "load_universal_into_engine",
 ]

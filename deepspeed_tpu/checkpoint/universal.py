@@ -1,0 +1,150 @@
+"""Universal checkpointing: reshape checkpoints across (dp, tp, pp) changes.
+
+TPU-native analogue of ``deepspeed/checkpoint/`` (``ds_to_universal.py``:
+``extract_zero_shards`` :92 / ``merge_tp_slices`` :189 / main :352,
+``DeepSpeedCheckpoint`` deepspeed_checkpoint.py:35,
+``load_hp_checkpoint_state`` universal_checkpoint.py:22).
+
+The reference needs a 3-stage offline pipeline because its shards are
+rank-local torch files whose slicing encodes the old topology.  Orbax
+checkpoints are *logically global* already — every param is stored whole
+and restores into any sharding — so the universal format here is simply:
+
+* one fp32 npz of consolidated params + optimizer moments (the "atom"
+  files, host-readable without JAX), plus
+* a ``universal_meta.json`` with step/loss-scale counters,
+
+and loading means device_put into whatever mesh/sharding the *new*
+topology uses.  ``ds_to_universal`` therefore also serves as the offline
+``zero_to_fp32`` superset (it extracts moments, not just weights).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+
+UNIVERSAL_DIR = "universal"
+META_FILE = "universal_meta.json"
+ATOMS_FILE = "atoms.npz"
+
+
+from .zero_to_fp32 import _key_of, flatten_state_dict
+
+
+def _flatten_with_paths(tree: Any) -> Dict[str, np.ndarray]:
+    return flatten_state_dict(tree, sep="/")
+
+
+def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
+                    out_dir: Optional[str] = None) -> str:
+    """Convert a saved checkpoint into the universal format.
+
+    Reads the Orbax state (topology-free), writes consolidated fp32 atoms.
+    Returns the universal directory path.
+    """
+    import orbax.checkpoint as ocp
+    if tag is None:
+        with open(os.path.join(ckpt_dir, "latest")) as fh:
+            tag = fh.read().strip()
+    state_path = os.path.abspath(os.path.join(ckpt_dir, tag, "state"))
+    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+    state = ckptr.restore(state_path)
+
+    out_dir = out_dir or os.path.join(ckpt_dir, f"{tag}_{UNIVERSAL_DIR}")
+    os.makedirs(out_dir, exist_ok=True)
+
+    atoms: Dict[str, np.ndarray] = {}
+    for key, arr in _flatten_with_paths(state["params"]).items():
+        atoms[f"params/{key}"] = arr.astype(np.float32) \
+            if np.issubdtype(arr.dtype, np.floating) else arr
+    for key, arr in _flatten_with_paths(state["opt_state"]).items():
+        atoms[f"opt_state/{key}"] = arr
+    np.savez(os.path.join(out_dir, ATOMS_FILE), **atoms)
+
+    meta = {
+        "step": int(np.asarray(state["step"])),
+        "loss_scale": float(np.asarray(state["loss_scale"])),
+        "good_steps": int(np.asarray(state["good_steps"])),
+        "skipped_steps": int(np.asarray(state["skipped_steps"])),
+        "hysteresis": int(np.asarray(state["hysteresis"])),
+        "source_tag": tag,
+    }
+    cs_path = os.path.join(ckpt_dir, tag, "client_state.json")
+    if os.path.exists(cs_path):
+        with open(cs_path) as fh:
+            meta["client_state"] = json.load(fh)
+    with open(os.path.join(out_dir, META_FILE), "w") as fh:
+        json.dump(meta, fh)
+    logger.info("universal checkpoint written: %s (%d atoms)",
+                out_dir, len(atoms))
+    return out_dir
+
+
+def load_universal_into_engine(engine, universal_dir: str,
+                               strict: bool = True) -> None:
+    """Restore a universal checkpoint into an engine with a possibly
+    DIFFERENT topology (new dp/tp/pp/fsdp mesh) — the reference's
+    ``--universal-checkpoint`` load path (universal_checkpoint.py:22)."""
+    with np.load(os.path.join(universal_dir, ATOMS_FILE)) as z:
+        atoms = {k: np.asarray(z[k]) for k in z.files}
+    with open(os.path.join(universal_dir, META_FILE)) as fh:
+        meta = json.load(fh)
+
+    state = engine.state
+    sh = engine._state_shardings_cache
+
+    def rebuild(subtree, sub_sh, prefix):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(subtree)
+        flat_sh = jax.tree.leaves(sub_sh)
+        leaves = []
+        for (path, leaf), leaf_sh in zip(flat, flat_sh):
+            key = prefix + "/".join(_key_of(p) for p in path)
+            if key not in atoms:
+                if strict:
+                    raise KeyError(
+                        f"universal checkpoint missing atom {key!r}")
+                leaves.append(leaf)
+                continue
+            arr = atoms[key]
+            if tuple(arr.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f"atom {key!r} shape {arr.shape} != current "
+                    f"{tuple(leaf.shape)} — universal atoms are global "
+                    f"(unsharded); a mismatch means a different MODEL, "
+                    f"not a different topology")
+            leaves.append(jax.device_put(arr.astype(leaf.dtype), leaf_sh))
+        return jax.tree.unflatten(treedef, leaves)
+
+    import jax.numpy as jnp
+    with engine.topology.mesh:
+        new_params = rebuild(state.params, _params_shardings(engine),
+                             "params/")
+        new_opt = rebuild(state.opt_state, sh.opt_state, "opt_state/")
+    engine.state = state.replace(
+        params=new_params, opt_state=new_opt,
+        step=jnp.asarray(meta["step"], jnp.int32),
+        loss_scale=jnp.asarray(meta["loss_scale"], jnp.float32),
+        good_steps=jnp.asarray(meta["good_steps"], jnp.int32),
+        skipped_steps=jnp.asarray(meta["skipped_steps"], jnp.int32),
+        hysteresis=jnp.asarray(meta["hysteresis"], jnp.int32))
+    cs = meta.get("client_state", {})
+    engine.global_steps = cs.get("global_steps", meta["step"])
+    engine.global_samples = cs.get("global_samples", 0)
+    engine.micro_steps = cs.get("micro_steps", 0)
+    if "lr_scheduler" in cs:
+        engine.lr_scheduler.load_state_dict(cs["lr_scheduler"])
+    logger.info("universal checkpoint loaded from %s into mesh %s",
+                universal_dir,
+                dict(zip(engine.topology.mesh.axis_names,
+                         engine.topology.mesh.devices.shape)))
+
+
+def _params_shardings(engine):
+    return engine._state_shardings_cache.params

@@ -51,14 +51,26 @@ def _tree_to_host_fp32(tree: Any) -> Any:
         lambda x: np.asarray(x, dtype=np.float32), tree)
 
 
-def flatten_state_dict(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
-    """Nested dict -> flat {'a.b.c': array} (torch-state-dict style keys)."""
+def _key_of(entry) -> str:
+    """Uniform rendering of one pytree path entry: DictKey('a'),
+    GetAttrKey('count') (namedtuple field) and SequenceKey(0) all become
+    bare names, so a namedtuple and the dict Orbax restores it as produce
+    the same flat key."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def flatten_state_dict(tree: Any, prefix: str = "",
+                       sep: str = ".") -> Dict[str, np.ndarray]:
+    """Any pytree -> flat {'a.b.c': array} (torch-state-dict style keys;
+    ``sep='/'`` gives the universal-checkpoint atom key scheme)."""
+    import jax
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     out: Dict[str, np.ndarray] = {}
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            out.update(flatten_state_dict(v, f"{prefix}{k}."))
-    else:
-        out[prefix[:-1]] = tree
+    for path, leaf in flat:
+        out[prefix + sep.join(_key_of(p) for p in path)] = np.asarray(leaf)
     return out
 
 

@@ -1,0 +1,17 @@
+"""Compression (reference ``deepspeed/compression/``)."""
+
+from .compress import (  # noqa: F401
+    CompressionManager,
+    CompressionScheduler,
+    init_compression,
+)
+from .utils import (  # noqa: F401
+    apply_mask,
+    channel_mask,
+    compress_rows,
+    head_mask,
+    magnitude_mask,
+    quantize_activation,
+    quantize_weight,
+    row_mask,
+)

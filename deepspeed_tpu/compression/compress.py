@@ -1,0 +1,235 @@
+"""Compression manager: scheduled layer-group compression.
+
+TPU-native analogue of ``deepspeed/compression/compress.py``
+(``init_compression`` / ``redundancy_clean``) + ``compression/scheduler.py``
+(``CompressionScheduler`` drives per-group ``schedule_offset``).
+
+Config shape mirrors the reference (``compression_training`` block)::
+
+    {"weight_quantization": {
+        "shared_parameters": {"enabled": true, "schedule_offset": 100},
+        "different_groups": {
+            "wq1": {"params": {"start_bits": 8, "target_bits": 4,
+                               "quantization_period": 50},
+                    "modules": ["attn", "mlp"]}}},
+     "sparse_pruning": {...}, "row_pruning": {...},
+     "head_pruning": {...}, "channel_pruning": {...}}
+
+``modules`` entries are substring/regex patterns over param paths (the
+reference matches nn.Module names).  The manager computes masks/quant
+transforms once past each group's schedule offset and applies them to the
+param tree at gradient-accumulation boundaries; masks are cached so
+pruning decisions are sticky (reference behavior after mask creation).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist, logger
+from . import utils as U
+
+KINDS = ("weight_quantization", "activation_quantization", "sparse_pruning",
+         "row_pruning", "head_pruning", "channel_pruning")
+
+
+@dataclass
+class CompressionGroup:
+    kind: str
+    name: str
+    patterns: List[str]
+    params: Dict[str, Any]
+    schedule_offset: int
+    matched: List[Tuple[int, str]] = field(default_factory=list)
+    masks: Dict[int, jax.Array] = field(default_factory=dict)
+
+    def matches(self, path: str) -> bool:
+        return any(re.search(p, path) for p in self.patterns)
+
+    def current_bits(self, global_step: int) -> int:
+        """Progressive bit reduction (start_bits -> target_bits every
+        quantization_period steps, reference quantize scheduler)."""
+        start = int(self.params.get("start_bits", 8))
+        target = int(self.params.get("target_bits", start))
+        period = int(self.params.get("quantization_period", 1))
+        if global_step < self.schedule_offset:
+            return 32
+        # halve toward target each period
+        steps = (global_step - self.schedule_offset) // max(1, period)
+        bits = start
+        for _ in range(steps):
+            if bits <= target:
+                break
+            bits = max(target, bits // 2 if bits > target * 2 else target)
+        return bits
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return ".".join(parts)
+
+
+class CompressionScheduler:
+    """Step-driven trigger (reference ``compression/scheduler.py``)."""
+
+    def __init__(self, manager: "CompressionManager"):
+        self.manager = manager
+
+    def step(self, params: Any, global_step: int) -> Any:
+        return self.manager.apply(params, global_step)
+
+
+class CompressionManager:
+    def __init__(self, config: Dict[str, Any], abstract_params: Any):
+        self.groups: List[CompressionGroup] = []
+        self._jit_cache: Dict[Tuple, Callable] = {}
+        flat, self._treedef = jax.tree_util.tree_flatten_with_path(
+            abstract_params)
+        self._paths = [_path_str(p) for p, _ in flat]
+        for kind in KINDS:
+            block = config.get(kind) or {}
+            if hasattr(block, "items") and not isinstance(block, dict):
+                block = dict(block)
+            shared = block.get("shared_parameters", {})
+            if not shared.get("enabled", False):
+                continue
+            offset = int(shared.get("schedule_offset", 0))
+            for name, group in block.get("different_groups", {}).items():
+                cg = CompressionGroup(
+                    kind=kind, name=name,
+                    patterns=[str(m) for m in group.get("modules", [".*"])],
+                    params=dict(group.get("params", {})),
+                    schedule_offset=int(group.get(
+                        "schedule_offset", offset)))
+                cg.matched = [(i, p) for i, p in enumerate(self._paths)
+                              if cg.matches(p)]
+                if not cg.matched:
+                    logger.warning("compression group %s/%s matched no "
+                                   "parameters (patterns %s)", kind, name,
+                                   cg.patterns)
+                self.groups.append(cg)
+        self.param_groups = [g for g in self.groups
+                             if g.kind != "activation_quantization"]
+        self.act_groups = [g for g in self.groups
+                           if g.kind == "activation_quantization"]
+        if self.act_groups:
+            logger.warning(
+                "activation_quantization is a FORWARD hook: the model must "
+                "call CompressionManager.act_quant(x, step) on the "
+                "activations it wants quantized — it does not alter params")
+        if self.groups:
+            log_dist(f"compression: {len(self.groups)} group(s) over "
+                     f"{sum(len(g.matched) for g in self.groups)} param "
+                     f"tensors", ranks=[0])
+
+    def min_param_offset(self) -> int:
+        return min((g.schedule_offset for g in self.param_groups), default=0)
+
+    # ----------------------------------------------------- act-quant hook
+    def act_quant(self, x: jax.Array, global_step: int) -> jax.Array:
+        """Quantize one activation tensor per the first eligible
+        activation_quantization group (model-forward hook)."""
+        for g in self.act_groups:
+            if global_step >= g.schedule_offset:
+                return U.quantize_activation(
+                    x, int(g.params.get("bits", 8)),
+                    symmetric=g.params.get("symmetric", True))
+        return x
+
+    # ------------------------------------------------------------- apply
+    def _ensure_masks(self, flat: List[Any], active) -> None:
+        for g in active:
+            if g.kind == "weight_quantization":
+                continue
+            ratio = float(g.params.get("dense_ratio", 0.5))
+            for i, _ in g.matched:
+                leaf = flat[i]
+                if i in g.masks or not hasattr(leaf, "dtype") or \
+                        not jnp.issubdtype(leaf.dtype, jnp.floating):
+                    continue
+                if g.kind == "sparse_pruning":
+                    g.masks[i] = U.magnitude_mask(leaf, ratio)
+                elif g.kind == "row_pruning":
+                    g.masks[i] = U.row_mask(leaf, ratio)
+                elif g.kind == "head_pruning":
+                    g.masks[i] = U.head_mask(
+                        leaf, int(g.params.get("num_heads", 1)), ratio)
+                elif g.kind == "channel_pruning":
+                    g.masks[i] = U.channel_mask(leaf, ratio)
+
+    def apply(self, params: Any, global_step: int) -> Any:
+        """Compressed view of ``params``: one jit-compiled projection per
+        (group, bits) signature — the per-step hot path dispatches ONE
+        compiled program, not per-leaf eager ops."""
+        active = [g for g in self.param_groups
+                  if global_step >= g.schedule_offset]
+        if not active:
+            return params
+        flat, treedef = jax.tree.flatten(params)
+        self._ensure_masks(flat, active)
+
+        key = tuple((g.kind, g.name, g.current_bits(global_step))
+                    for g in active)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            # static plan: (leaf index, op, bits, mask slot)
+            plan: List[Tuple[int, str, int, int]] = []
+            n_masks = 0
+            mask_order: List[Tuple[Any, int]] = []
+            for g in active:
+                bits = g.current_bits(global_step)
+                symmetric = bool(g.params.get("symmetric", True))
+                for i, _ in g.matched:
+                    if not hasattr(flat[i], "dtype") or \
+                            not jnp.issubdtype(flat[i].dtype, jnp.floating):
+                        continue
+                    if g.kind == "weight_quantization":
+                        plan.append((i, "q" if symmetric else "qa", bits, -1))
+                    elif i in g.masks:
+                        plan.append((i, "m", 0, n_masks))
+                        mask_order.append((g, i))
+                        n_masks += 1
+
+            def project(flat_in, masks):
+                out = list(flat_in)
+                for i, op, bits, mi in plan:
+                    if op == "m":
+                        out[i] = out[i] * masks[mi]
+                    else:
+                        out[i] = U.quantize_weight(out[i], bits,
+                                                   symmetric=op == "q")
+                return out
+
+            fn = (jax.jit(project), mask_order)
+            self._jit_cache[key] = fn
+        jit_fn, mask_order = fn
+        masks = [g.masks[i] for g, i in mask_order]
+        flat = jit_fn(flat, masks)
+        return jax.tree.unflatten(treedef, list(flat))
+
+    # --------------------------------------------------------- clean-up
+    def redundancy_clean(self, params: Any) -> Any:
+        """Physically shrink row-pruned tensors (reference
+        ``redundancy_clean``): fully-zero output channels are dropped."""
+        flat, treedef = jax.tree.flatten(params)
+        for g in self.groups:
+            if g.kind != "row_pruning":
+                continue
+            for i, _ in g.matched:
+                mask = g.masks.get(i)
+                if mask is not None:
+                    flat[i], _ = U.compress_rows(flat[i], mask)
+        return jax.tree.unflatten(treedef, flat)
+
+
+def init_compression(config: Dict[str, Any], abstract_params: Any
+                     ) -> CompressionManager:
+    """Reference ``init_compression`` entry point."""
+    return CompressionManager(config, abstract_params)

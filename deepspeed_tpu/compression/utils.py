@@ -1,0 +1,111 @@
+"""Compression primitives: fake quantization + pruning masks.
+
+TPU-native analogue of ``deepspeed/compression/basic_layer.py`` (121:
+``LinearLayer_Compress`` et al.) and ``compression/utils.py``.  The
+reference swaps ``nn.Linear`` for subclasses that quantize/prune inside
+``forward``; in a functional world the same math is a *transform over the
+param tree* applied at schedule boundaries — XLA folds the (de)quant into
+the surrounding program, which is exactly what the reference's
+``quantizer_kernel`` flag tried to buy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ----------------------------------------------------------- quantization
+
+def quantize_weight(w: jax.Array, bits: int, symmetric: bool = True,
+                    per_channel: bool = True) -> jax.Array:
+    """Fake (quant-dequant) weight quantization to ``bits``.
+
+    per_channel: scales per output channel (last dim) — the reference's
+    ``weight_quantize_in_forward`` group-wise path with one group/channel.
+    """
+    if bits >= 32:
+        return w
+    axis = tuple(range(w.ndim - 1)) if per_channel and w.ndim > 1 else None
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.max(jnp.abs(w), axis=axis, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax)
+        return (q * scale).astype(w.dtype)
+    qmax = 2.0 ** bits - 1
+    lo = jnp.min(w, axis=axis, keepdims=True)
+    hi = jnp.max(w, axis=axis, keepdims=True)
+    scale = jnp.where(hi > lo, (hi - lo) / qmax, 1.0)
+    q = jnp.clip(jnp.round((w - lo) / scale), 0, qmax)
+    return (q * scale + lo).astype(w.dtype)
+
+
+def quantize_activation(x: jax.Array, bits: int,
+                        symmetric: bool = True) -> jax.Array:
+    """Dynamic per-tensor activation fake-quant (``activation_quantization``
+    with ``range_calibration: dynamic``)."""
+    return quantize_weight(x, bits, symmetric=symmetric, per_channel=False)
+
+
+# ---------------------------------------------------------------- pruning
+
+def magnitude_mask(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Unstructured magnitude mask keeping the top ``dense_ratio`` weights
+    (``sparse_pruning`` method l1/topk)."""
+    k = max(1, int(round(dense_ratio * w.size)))
+    thresh = jnp.sort(jnp.abs(w).ravel())[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_mask(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Row (output-channel) mask by L1 norm (``row_pruning``): rows live on
+    the LAST dim in the jax [in, out] layout."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    k = max(1, int(round(dense_ratio * norms.size)))
+    thresh = jnp.sort(norms)[-k]
+    keep = (norms >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(keep, w.shape)
+
+
+def head_mask(w: jax.Array, num_heads: int,
+              dense_ratio: float) -> jax.Array:
+    """Attention-head mask (``head_pruning``) for [.., heads*dim] weights:
+    score heads by L1, keep the strongest fraction."""
+    out = w.shape[-1]
+    if out % num_heads:
+        raise ValueError(f"out dim {out} not divisible by {num_heads} heads")
+    hd = out // num_heads
+    grouped = w.reshape((-1, num_heads, hd))
+    norms = jnp.sum(jnp.abs(grouped), axis=(0, 2))
+    k = max(1, int(round(dense_ratio * num_heads)))
+    thresh = jnp.sort(norms)[-k]
+    keep = (norms >= thresh).astype(w.dtype)  # [heads]
+    return jnp.broadcast_to(
+        jnp.repeat(keep, hd), w.shape[:-1] + (out,))
+
+
+def channel_mask(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Input-channel mask (``channel_pruning``): channels = dim -2."""
+    if w.ndim < 2:
+        return jnp.ones_like(w)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != w.ndim - 2)
+    norms = jnp.sum(jnp.abs(w), axis=reduce_axes)
+    k = max(1, int(round(dense_ratio * norms.size)))
+    thresh = jnp.sort(norms)[-k]
+    keep = (norms >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(keep[:, None], w.shape)
+
+
+def apply_mask(w: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+    return w if mask is None else w * mask
+
+
+def compress_rows(w: jax.Array, mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Physically drop fully-masked output channels (``redundancy_clean``):
+    returns (smaller array, kept-index vector)."""
+    keep_vec = mask.reshape((-1, mask.shape[-1]))[0] > 0
+    idx = jnp.where(keep_vec)[0]
+    return jnp.take(w, idx, axis=-1), idx

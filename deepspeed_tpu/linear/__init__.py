@@ -1,0 +1,8 @@
+"""LoRA / OptimizedLinear (reference ``deepspeed/linear/``)."""
+
+from .optimized_linear import (  # noqa: F401
+    LoRAConfig,
+    OptimizedLinear,
+    QuantizationConfig,
+    lora_trainable_mask,
+)

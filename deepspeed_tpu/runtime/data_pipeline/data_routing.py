@@ -1,0 +1,107 @@
+"""Random-LTD: random layerwise token dropping.
+
+TPU-native analogue of ``deepspeed/runtime/data_pipeline/data_routing/``
+(+ ``csrc/random_ltd/`` 724 LoC of CUDA gather/scatter): middle transformer
+layers process a random *subset* of tokens, first/last layers see all —
+the dropped tokens ride the residual stream unchanged.  The CUDA
+gather/scatter kernels become ``jnp.take_along_axis`` / ``.at[].set``
+(XLA lowers them to efficient dynamic-gather on TPU); the kept-token count
+follows a per-step schedule so shapes stay static within a schedule stage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class RandomLTDScheduler:
+    """Kept-sequence-length schedule (reference ``ltd_scheduler``):
+    linear ramp from ``min_value`` tokens to the full ``max_value`` over
+    ``schedule_config.total_layer_tokens``-style step budget."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.min_value = int(config.get("min_value", 128))
+        self.max_value = int(config.get("max_value", 1024))
+        sc = config.get("schedule_config", {})
+        self.total_steps = int(sc.get("total_steps",
+                                      config.get("total_steps", 10000)))
+        self.step_size = int(sc.get("seq_per_step", 16))
+
+    def get_value(self, global_step: int) -> int:
+        frac = min(1.0, global_step / max(1, self.total_steps))
+        raw = self.min_value + (self.max_value - self.min_value) * frac
+        v = int(raw // self.step_size) * self.step_size
+        return max(self.min_value, min(self.max_value, v))
+
+
+def token_sort_indices(rng: jax.Array, batch: int, seq: int,
+                       keep: int) -> Tuple[jax.Array, jax.Array]:
+    """Random kept-token indices [B, keep] (sorted, preserving order) and
+    the complement [B, seq-keep] (reference ``token_sort``/gather kernel)."""
+    noise = jax.random.uniform(rng, (batch, seq))
+    order = jnp.argsort(noise, axis=-1)
+    kept = jnp.sort(order[:, :keep], axis=-1)
+    dropped = jnp.sort(order[:, keep:], axis=-1)
+    return kept, dropped
+
+
+def gather_tokens(x: jax.Array, indices: jax.Array) -> jax.Array:
+    """[B, S, H] gather -> [B, keep, H] (csrc/random_ltd gather kernel)."""
+    return jnp.take_along_axis(x, indices[:, :, None], axis=1)
+
+
+def scatter_tokens(full: jax.Array, sub: jax.Array,
+                   indices: jax.Array) -> jax.Array:
+    """Write processed kept tokens back into the full residual stream
+    (csrc/random_ltd scatter kernel): dropped tokens keep their value."""
+    b = jnp.arange(full.shape[0])[:, None]
+    return full.at[b, indices].set(sub)
+
+
+def apply_random_ltd(layer_fn: Callable[[jax.Array], jax.Array],
+                     x: jax.Array, keep: int, rng: jax.Array) -> jax.Array:
+    """Run ``layer_fn`` on a random ``keep``-token subset; dropped tokens
+    bypass the layer via the residual stream (the Random-LTD forward)."""
+    b, s = x.shape[0], x.shape[1]
+    if keep >= s:
+        return layer_fn(x)
+    kept_idx, _ = token_sort_indices(rng, b, s, keep)
+    sub = gather_tokens(x, kept_idx)
+    sub = layer_fn(sub)
+    return scatter_tokens(x, sub, kept_idx)
+
+
+class ProgressiveLayerDrop:
+    """PLD (reference ``runtime/progressive_layer_drop.py:10``): global
+    keep-probability theta(t) decays from 1 toward ``theta`` with rate
+    ``gamma``; layer i's keep prob interpolates toward theta with depth."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (1.0 - self.theta) * np.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def layer_keep_prob(self, layer_idx: int, num_layers: int) -> float:
+        """Deeper layers drop more (stochastic-depth linear rule)."""
+        frac = (layer_idx + 1) / max(1, num_layers)
+        return 1.0 - frac * (1.0 - self.current_theta)
+
+    def state_dict(self):
+        return {"current_theta": self.current_theta}
+
+    def load_state_dict(self, sd):
+        self.current_theta = float(sd["current_theta"])

@@ -1,0 +1,134 @@
+"""Curriculum-aware data sampler + offline difficulty analyzer.
+
+TPU-native analogues of ``deepspeed/runtime/data_pipeline/data_sampling/``:
+
+* ``DataAnalyzer`` (data_analyzer.py, 880 LoC): offline pass computing a
+  difficulty metric per sample, persisting metric values and a
+  difficulty-sorted sample index;
+* ``DeepSpeedDataSampler`` (data_sampler.py:36): at each step, admit only
+  samples whose difficulty ≤ the curriculum's current threshold, shuffle
+  deterministically, shard across DP ranks.
+
+Batches stay static-shape: the eligible pool only grows (curriculum
+difficulty is monotone), and batch size is constant — XLA never sees the
+curriculum at all, it is pure host-side index selection.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class DataAnalyzer:
+    """Offline difficulty indexing (reference ``DataAnalyzer``)."""
+
+    def __init__(self, dataset: Sequence[Any], metric_fns: Dict[str, Callable[[Any], float]],
+                 save_path: str, num_workers: int = 1, worker_id: int = 0):
+        self.dataset = dataset
+        self.metric_fns = metric_fns
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+
+    def _paths(self, metric: str):
+        base = os.path.join(self.save_path, metric)
+        return base + "_metric_values.npy", base + "_sample_to_metric.npy"
+
+    def run_map_reduce(self) -> None:
+        """Compute metrics over this worker's shard, then merge
+        (single-process path computes everything)."""
+        os.makedirs(self.save_path, exist_ok=True)
+        n = len(self.dataset)
+        shard = range(self.worker_id, n, self.num_workers)
+        for name, fn in self.metric_fns.items():
+            values = np.full(n, np.nan, np.float64)
+            for i in shard:
+                values[i] = float(fn(self.dataset[i]))
+            vals_path, s2m_path = self._paths(name)
+            if self.num_workers > 1 and os.path.exists(vals_path):
+                prev = np.load(vals_path)
+                mask = ~np.isnan(prev)
+                values[mask] = prev[mask]
+            np.save(vals_path, values)
+            if not np.isnan(values).any():
+                np.save(s2m_path, np.argsort(values, kind="stable"))
+        logger.info("DataAnalyzer: wrote metrics %s to %s",
+                    sorted(self.metric_fns), self.save_path)
+
+    @staticmethod
+    def load(save_path: str, metric: str):
+        base = os.path.join(save_path, metric)
+        return (np.load(base + "_metric_values.npy"),
+                np.load(base + "_sample_to_metric.npy"))
+
+
+class DeepSpeedDataSampler:
+    """Curriculum batch sampler (reference ``DeepSpeedDataSampler``).
+
+    Yields per-step lists of *global sample indices* for this DP rank.
+    """
+
+    def __init__(self,
+                 difficulties: np.ndarray,
+                 curriculum_scheduler,
+                 global_batch_size: int,
+                 data_parallel_rank: int = 0,
+                 data_parallel_size: int = 1,
+                 drop_last: bool = True,
+                 seed: int = 1234):
+        if global_batch_size % data_parallel_size:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"dp size {data_parallel_size}")
+        self.difficulties = np.asarray(difficulties, np.float64)
+        self.sorted_idx = np.argsort(self.difficulties, kind="stable")
+        self.sorted_vals = self.difficulties[self.sorted_idx]
+        self.scheduler = curriculum_scheduler
+        self.global_batch_size = global_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.micro = global_batch_size // data_parallel_size
+        self.drop_last = drop_last
+        self.seed = seed
+        self.global_step = 0
+        self._consumed = 0  # within the current eligible pool epoch
+
+    def eligible_count(self) -> int:
+        d = self.scheduler.update_difficulty(self.global_step)
+        return int(np.searchsorted(self.sorted_vals, d, side="right"))
+
+    def __iter__(self) -> Iterator[List[int]]:
+        return self
+
+    def __next__(self) -> List[int]:
+        n_elig = self.eligible_count()
+        if n_elig < self.global_batch_size:
+            # too few easy samples yet: fall back to the easiest batch-size
+            # pool (reference keeps training rather than starving; an empty
+            # pool would crash rng.choice regardless of drop_last)
+            n_elig = min(len(self.sorted_idx), self.global_batch_size)
+        pool = self.sorted_idx[:n_elig]
+        # deterministic shuffle that changes per step but is stable across
+        # ranks (same seed -> same permutation; rank slices differ)
+        rng = np.random.default_rng(self.seed + self.global_step)
+        picks = rng.choice(pool.size, size=self.global_batch_size,
+                           replace=pool.size < self.global_batch_size)
+        batch = pool[picks]
+        shard = batch[self.dp_rank * self.micro:(self.dp_rank + 1) * self.micro]
+        self.global_step += 1
+        return [int(i) for i in shard]
+
+    # ------------------------------------------------------- persistence
+    def state_dict(self) -> Dict[str, Any]:
+        return {"global_step": self.global_step, "seed": self.seed,
+                "scheduler": self.scheduler.state_dict()}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.global_step = int(sd["global_step"])
+        self.seed = int(sd["seed"])
+        self.scheduler.load_state_dict(sd["scheduler"])

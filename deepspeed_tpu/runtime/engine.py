@@ -182,6 +182,7 @@ class DeepSpeedEngine:
             init_params = params
         else:
             init_params = self._init_params()  # sets self._abstract_params
+        self._maybe_enable_compression()
         self._maybe_enable_offload()
         if self.offload is not None:
             # masters come from the fp32 initializer output, BEFORE the
@@ -223,6 +224,33 @@ class DeepSpeedEngine:
     def _build_optimizer(self, opt_cfg) -> optax.GradientTransformation:
         return get_optimizer(opt_cfg.type, opt_cfg.params,
                              lr_schedule=lambda count: self._traced_lr(count))
+
+    def _maybe_enable_compression(self) -> None:
+        """Scheduled compression (reference engine fwd hook engine.py:1862
+        + compression/scheduler.py).  Functionally: weights are projected
+        onto the compressed set (masks/quant grid) after each update."""
+        self.compression = None
+        comp_cfg = self.config.compression_training
+        blocks = {k: getattr(comp_cfg, k) for k in (
+            "weight_quantization", "activation_quantization",
+            "sparse_pruning", "row_pruning", "head_pruning",
+            "channel_pruning")}
+        if not any(b.get("shared_parameters", {}).get("enabled", False)
+                   for b in blocks.values() if isinstance(b, dict)):
+            return
+        from ..compression import init_compression
+        unboxed_abstract = jax.eval_shape(unbox, self._abstract_params)
+        self.compression = init_compression(blocks, unboxed_abstract)
+        self._compression_min_offset = self.compression.min_param_offset()
+
+    def _maybe_apply_compression(self) -> None:
+        if self.compression is None or not self.compression.param_groups \
+                or self.global_steps < self._compression_min_offset:
+            return
+        with self.topology.mesh:
+            self.state = self.state.replace(
+                params=self.compression.apply(self.state.params,
+                                              self.global_steps))
 
     def _maybe_enable_offload(self) -> None:
         """ZeRO-Offload: mask offloaded leaves out of the device optimizer
@@ -618,6 +646,7 @@ class DeepSpeedEngine:
         loss = float(metrics["loss"])
         self._last_grad_norm = float(metrics["grad_norm"])
         self.global_steps += 1
+        self._maybe_apply_compression()
         self.micro_steps += self.gradient_accumulation_steps()
         self.global_samples += self.train_batch_size()
         self.lr_scheduler.step()

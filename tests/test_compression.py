@@ -1,0 +1,154 @@
+"""Compression tests (reference ``tests/unit/compression/
+test_compression.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.compression import (CompressionManager, apply_mask,
+                                       channel_mask, compress_rows,
+                                       head_mask, init_compression,
+                                       magnitude_mask, quantize_weight,
+                                       row_mask)
+from deepspeed_tpu.models.base import SimpleModel
+
+
+# --------------------------------------------------------------- primitives
+
+def test_quantize_weight_grid():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    q8 = quantize_weight(w, 8)
+    q2 = quantize_weight(w, 2)
+    assert float(jnp.max(jnp.abs(q8 - w))) < float(jnp.max(jnp.abs(q2 - w)))
+    # 2-bit symmetric: at most 4 distinct levels per output channel
+    for col in np.asarray(q2).T:
+        assert len(np.unique(col)) <= 4
+    # 32 bits: identity
+    np.testing.assert_array_equal(np.asarray(quantize_weight(w, 32)),
+                                  np.asarray(w))
+
+
+def test_quantize_asymmetric_covers_range():
+    w = jnp.asarray(np.linspace(0.0, 1.0, 64, dtype=np.float32))
+    q = quantize_weight(w, 4, symmetric=False, per_channel=False)
+    assert float(jnp.min(q)) == pytest.approx(0.0, abs=1e-6)
+    assert float(jnp.max(q)) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_magnitude_mask_ratio():
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(32, 32)))
+    m = magnitude_mask(w, 0.25)
+    assert float(m.sum()) == pytest.approx(0.25 * w.size, rel=0.05)
+    # masked weights are the smallest ones
+    kept_min = float(jnp.min(jnp.where(m > 0, jnp.abs(w), jnp.inf)))
+    dropped_max = float(jnp.max(jnp.where(m == 0, jnp.abs(w), -jnp.inf)))
+    assert kept_min >= dropped_max
+
+
+def test_row_head_channel_masks():
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+    rm = row_mask(w, 0.5)
+    assert rm.shape == w.shape
+    cols_kept = np.asarray(rm).sum(axis=0) > 0
+    assert cols_kept.sum() == 16  # half the 32 output channels
+
+    hm = head_mask(w, num_heads=4, dense_ratio=0.5)
+    head_keep = np.asarray(hm)[0].reshape(4, 8)
+    assert set(head_keep.sum(axis=1)) <= {0.0, 8.0}  # whole heads
+    assert head_keep.sum() == 16
+
+    cm = channel_mask(w, 0.25)
+    rows_kept = np.asarray(cm).sum(axis=1) > 0
+    assert rows_kept.sum() == 4
+
+    with pytest.raises(ValueError):
+        head_mask(w, num_heads=5, dense_ratio=0.5)
+
+
+def test_compress_rows_shrinks():
+    w = jnp.asarray(np.random.default_rng(3).normal(size=(8, 16)))
+    m = row_mask(w, 0.5)
+    smaller, idx = compress_rows(apply_mask(w, m), m)
+    assert smaller.shape == (8, 8) and idx.shape == (8,)
+
+
+# ----------------------------------------------------------------- manager
+
+CFG = {
+    "weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 2},
+        "different_groups": {
+            "wq1": {"params": {"start_bits": 8, "target_bits": 4,
+                               "quantization_period": 2},
+                    "modules": [r"w\d"]}}},
+    "sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 3},
+        "different_groups": {
+            "sp1": {"params": {"dense_ratio": 0.5}, "modules": ["w1"]}}},
+}
+
+
+def test_manager_schedule_and_groups():
+    params = {"w1": jnp.asarray(np.random.default_rng(0).normal(
+        size=(16, 16)).astype(np.float32)),
+        "w2": jnp.ones((8, 8), jnp.float32),
+        "bias": jnp.ones((4,), jnp.float32)}
+    mgr = init_compression(CFG, jax.eval_shape(lambda p: p, params))
+    assert len(mgr.groups) == 2
+
+    # before offsets: untouched
+    out = mgr.apply(params, global_step=1)
+    np.testing.assert_array_equal(np.asarray(out["w1"]),
+                                  np.asarray(params["w1"]))
+    # past quant offset: w1/w2 quantized, bias untouched
+    out = mgr.apply(params, global_step=2)
+    assert not np.array_equal(np.asarray(out["w1"]), np.asarray(params["w1"]))
+    np.testing.assert_array_equal(np.asarray(out["bias"]), 1.0)
+    # past prune offset: w1 also half-sparse (sticky mask)
+    out3 = mgr.apply(params, global_step=10)
+    sparsity = float((np.asarray(out3["w1"]) == 0).mean())
+    assert sparsity == pytest.approx(0.5, abs=0.1)
+    out4 = mgr.apply(params, global_step=11)
+    np.testing.assert_array_equal(np.asarray(out3["w1"]) == 0,
+                                  np.asarray(out4["w1"]) == 0)
+
+
+def test_progressive_bits():
+    mgr = init_compression(CFG, {"w1": jax.ShapeDtypeStruct((4, 4),
+                                                            jnp.float32)})
+    g = next(g for g in mgr.groups if g.kind == "weight_quantization")
+    assert g.current_bits(0) == 32       # before offset
+    assert g.current_bits(2) == 8        # at offset: start_bits
+    assert g.current_bits(4) == 4        # one period later: halved to target
+    assert g.current_bits(100) == 4      # floor at target
+
+
+def test_engine_integration_prunes_params():
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "compression_training": {
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 2},
+                "different_groups": {
+                    "sp1": {"params": {"dense_ratio": 0.5},
+                            "modules": [r"layer_.*\.w$"]}}}},
+        "checkpoint": {"async_save": False},
+    }
+    engine, *_ = dst.initialize(model=SimpleModel(16), config=cfg)
+    assert engine.compression is not None
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.normal(size=(32, 16)).astype(np.float32),
+             "y": rng.normal(size=(32, 16)).astype(np.float32)}
+    for _ in range(4):
+        engine.train_batch(batch)
+    flat = jax.tree_util.tree_flatten_with_path(engine.state.params)[0]
+    pruned = [np.asarray(leaf) for path, leaf in flat
+              if ".".join(str(getattr(p, "key", p))
+                          for p in path).endswith(".w")]
+    assert pruned and all(
+        (p == 0).mean() == pytest.approx(0.5, abs=0.1) for p in pruned)

@@ -1,0 +1,196 @@
+"""Data-efficiency tests (reference ``tests/unit/runtime/
+test_data_efficiency.py``, ``data_sampling`` suites)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler,
+    DataAnalyzer,
+    DeepSpeedDataSampler,
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    ProgressiveLayerDrop,
+    RandomLTDScheduler,
+    apply_random_ltd,
+    gather_tokens,
+    scatter_tokens,
+    token_sort_indices,
+)
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+
+# ------------------------------------------------------------- curriculum
+
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(50) == 32  # halfway: 8 + 56*0.5 = 36 -> floor 32
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(10_000) == 64
+    # multiples of difficulty_step only
+    assert all(s.get_difficulty(t) % 8 == 0 for t in range(0, 120, 7))
+
+
+def test_fixed_root_reaches_max_faster_than_linear():
+    cfg = {"min_difficulty": 10, "max_difficulty": 100,
+           "schedule_config": {"total_curriculum_step": 100,
+                               "difficulty_step": 1}}
+    lin = CurriculumScheduler({**cfg, "schedule_type": "fixed_linear"})
+    root = CurriculumScheduler({**cfg, "schedule_type": "fixed_root"})
+    assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+
+def test_fixed_discrete_and_errors():
+    s = CurriculumScheduler({
+        "min_difficulty": 1, "max_difficulty": 4,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [1, 2, 4], "max_step": [10, 20]}})
+    assert s.get_difficulty(5) == 1
+    assert s.get_difficulty(15) == 2
+    assert s.get_difficulty(50) == 4
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"schedule_type": "fixed_linear"})
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"schedule_type": "warp"})
+
+
+# --------------------------------------------------------- indexed dataset
+
+def test_mmap_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "corpus")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
+    for d in docs:
+        builder.add_item(d)
+        builder.end_document()
+    builder.finalize()
+
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 4
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(ds[i], np.asarray(d, np.int32))
+    np.testing.assert_array_equal(ds.get(2, offset=1, length=2), [7, 8])
+    assert MMapIndexedDataset.exists(prefix)
+    assert not MMapIndexedDataset.exists(str(tmp_path / "nope"))
+
+
+def test_mmap_builder_merge(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    for prefix, docs in ((a, [[1, 2]]), (b, [[3], [4, 5]])):
+        bld = MMapIndexedDatasetBuilder(prefix, dtype=np.uint16)
+        for d in docs:
+            bld.add_item(d)
+            bld.end_document()
+        bld.finalize()
+    merged = MMapIndexedDatasetBuilder(str(tmp_path / "m"), dtype=np.uint16)
+    merged.merge_file(a)
+    merged.merge_file(b)
+    merged.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert [list(ds[i]) for i in range(3)] == [[1, 2], [3], [4, 5]]
+
+
+# --------------------------------------------------------------- sampler
+
+def _sched(total=100):
+    return CurriculumScheduler({
+        "min_difficulty": 2, "max_difficulty": 100,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": total,
+                            "difficulty_step": 1}})
+
+
+def test_analyzer_and_sampler(tmp_path):
+    dataset = [list(range(n)) for n in
+               np.random.default_rng(0).integers(1, 100, 64)]
+    an = DataAnalyzer(dataset, {"seqlen": len}, str(tmp_path))
+    an.run_map_reduce()
+    vals, s2m = DataAnalyzer.load(str(tmp_path), "seqlen")
+    assert vals.shape == (64,)
+    assert (np.diff(vals[s2m]) >= 0).all()
+
+    sampler = DeepSpeedDataSampler(vals, _sched(), global_batch_size=8,
+                                   data_parallel_rank=0,
+                                   data_parallel_size=2)
+    batch0 = next(sampler)
+    assert len(batch0) == 4  # micro share of dp rank
+    # early steps: only easy samples are eligible
+    assert all(vals[i] <= max(8, sampler.scheduler.current_difficulty + 8)
+               for i in batch0)
+    # later: harder samples appear
+    for _ in range(200):
+        batch = next(sampler)
+    assert max(vals[i] for i in batch) > 10
+
+
+def test_sampler_rank_shards_disjoint():
+    vals = np.arange(32, dtype=np.float64)
+    s0 = DeepSpeedDataSampler(vals, _sched(), 8, 0, 2, seed=7)
+    s1 = DeepSpeedDataSampler(vals, _sched(), 8, 1, 2, seed=7)
+    b0, b1 = next(s0), next(s1)
+    assert not set(b0) & set(b1)  # same permutation, disjoint slices
+
+
+# ------------------------------------------------------------- random-LTD
+
+def test_token_gather_scatter_roundtrip():
+    rng = jax.random.key(0)
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    kept, dropped = token_sort_indices(rng, 2, 8, 5)
+    assert kept.shape == (2, 5) and dropped.shape == (2, 3)
+    sub = gather_tokens(x, kept)
+    back = scatter_tokens(x, sub, kept)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_apply_random_ltd_passthrough_for_dropped():
+    rng = jax.random.key(1)
+    x = jnp.ones((2, 16, 4))
+    out = apply_random_ltd(lambda t: t * 2.0, x, keep=4, rng=rng)
+    flat = np.asarray(out).reshape(-1, 4)
+    doubled = (flat == 2.0).all(axis=-1).sum()
+    kept_tokens = 2 * 4
+    assert doubled == kept_tokens  # exactly the kept tokens were processed
+    # full keep: layer applies to everything
+    out_full = apply_random_ltd(lambda t: t * 2.0, x, keep=16, rng=rng)
+    assert (np.asarray(out_full) == 2.0).all()
+
+
+def test_random_ltd_scheduler_ramp():
+    s = RandomLTDScheduler({"min_value": 64, "max_value": 256,
+                            "schedule_config": {"total_steps": 100,
+                                                "seq_per_step": 16}})
+    assert s.get_value(0) == 64
+    assert s.get_value(100) == 256
+    assert s.get_value(50) == 160
+    assert all(s.get_value(t) % 16 == 0 for t in range(0, 110, 13))
+
+
+def test_progressive_layer_drop():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.update_state(0) == pytest.approx(1.0)
+    late = pld.update_state(10_000)
+    assert late == pytest.approx(0.5, abs=1e-3)
+    # deeper layers drop more
+    assert pld.layer_keep_prob(0, 12) > pld.layer_keep_prob(11, 12)
+
+
+# -------------------------------------------------------------- eigenvalue
+
+def test_eigenvalue_quadratic_exact():
+    # loss = 0.5 * x^T diag(d) x has eigenvalues d -> top = max(d)
+    d = jnp.asarray([1.0, 5.0, 3.0])
+
+    def loss(p, batch):
+        return 0.5 * jnp.sum(d * p["x"] ** 2)
+
+    ev = Eigenvalue(max_iter=50).compute_eigenvalue(
+        loss, {"x": jnp.ones(3)}, batch=None)
+    assert ev == pytest.approx(5.0, rel=1e-3)

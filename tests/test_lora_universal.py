@@ -1,0 +1,127 @@
+"""LoRA/OptimizedLinear + universal checkpoint tests (reference
+``tests/unit/linear/``, ``tests/unit/checkpoint/test_universal_checkpoint.py``
+and the DistributedFixture reshape pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.checkpoint import (ds_to_universal,
+                                      load_universal_into_engine)
+from deepspeed_tpu.linear import (LoRAConfig, OptimizedLinear,
+                                  QuantizationConfig, lora_trainable_mask)
+from deepspeed_tpu.models.base import SimpleModel
+
+
+# ------------------------------------------------------------------- LoRA
+
+def test_lora_starts_as_identity_adapter():
+    lin = OptimizedLinear(32, 16, lora_config=LoRAConfig(lora_r=4))
+    params = lin.init(jax.random.key(0))
+    x = jnp.ones((2, 32))
+    base_only = x.astype(lin.dtype) @ params["base"]
+    np.testing.assert_allclose(np.asarray(lin.apply(params, x)),
+                               np.asarray(base_only), rtol=1e-6)
+
+
+def test_lora_adapter_changes_output_and_merge():
+    lin = OptimizedLinear(8, 8, lora_config=LoRAConfig(lora_r=2,
+                                                       lora_alpha=4))
+    params = lin.init(jax.random.key(0))
+    params["lora_b"] = jnp.ones_like(params["lora_b"])
+    x = jnp.ones((1, 8))
+    out = lin.apply(params, x)
+    merged = x.astype(jnp.float32) @ lin.merge(params)
+    np.testing.assert_allclose(np.asarray(out).astype(np.float32),
+                               np.asarray(merged), rtol=2e-2, atol=2e-2)
+    base_only = x.astype(lin.dtype) @ params["base"]
+    assert not np.allclose(np.asarray(out), np.asarray(base_only))
+
+
+def test_quantized_base_close_to_dense():
+    rng = jax.random.key(1)
+    base = jax.random.normal(rng, (64, 32), jnp.float32)
+    dense = OptimizedLinear(64, 32, dtype=jnp.float32)
+    quant = OptimizedLinear(64, 32, dtype=jnp.float32,
+                            quantization_config=QuantizationConfig(
+                                group_size=64))
+    dp = dense.init(jax.random.key(2), base_weight=base)
+    qp = quant.init(jax.random.key(2), base_weight=base)
+    assert "base_q" in qp and qp["base_q"].dtype == jnp.int8
+    x = jax.random.normal(jax.random.key(3), (4, 64), jnp.float32)
+    np.testing.assert_allclose(np.asarray(quant.apply(qp, x)),
+                               np.asarray(dense.apply(dp, x)),
+                               rtol=0.1, atol=0.15)
+
+
+def test_trainable_mask_only_adapters():
+    lin = OptimizedLinear(8, 8, lora_config=LoRAConfig(lora_r=2), bias=True)
+    params = lin.init(jax.random.key(0))
+    mask = lin.trainable_mask(params)
+    assert mask == {"base": False, "lora_a": True, "lora_b": True,
+                    "bias": True}
+    tree = {"blk": {"q_proj": {"base": 1, "lora_a": 1, "lora_b": 1},
+                    "norm": {"scale": 1}}}
+    tmask = lora_trainable_mask(tree)
+    assert tmask["blk"]["q_proj"] == {"base": False, "lora_a": True,
+                                      "lora_b": True}
+    assert tmask["blk"]["norm"]["scale"] is False
+
+
+def test_lora_r_validation():
+    with pytest.raises(ValueError):
+        OptimizedLinear(4, 4, lora_config=LoRAConfig(lora_r=64))
+
+
+# ------------------------------------------------- universal checkpoint
+
+CFG_A = {  # zero-3 style: params sharded over fsdp
+    "train_micro_batch_size_per_gpu": 4,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    "zero_optimization": {"stage": 3},
+    "checkpoint": {"async_save": False},
+}
+CFG_B = {  # different topology: pure DP, stage 0
+    "train_micro_batch_size_per_gpu": 4,
+    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    "zero_optimization": {"stage": 0},
+    "tpu": {"mesh": {"data": -1}},
+    "checkpoint": {"async_save": False},
+}
+
+
+def _batch(d=16):
+    rng = np.random.default_rng(0)
+    return {"x": rng.normal(size=(32, d)).astype(np.float32),
+            "y": rng.normal(size=(32, d)).astype(np.float32)}
+
+
+def test_universal_roundtrip_across_topologies(tmp_path):
+    batch = _batch()
+    eng_s, *_ = dst.initialize(model=SimpleModel(16), config=CFG_A)
+    for _ in range(3):
+        eng_s.train_batch(batch)
+    eng_s.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    expected = [float(eng_s.train_batch(batch)) for _ in range(2)]
+
+    uni_dir = ds_to_universal(str(tmp_path / "ck"), tag="t")
+
+    # load into a DIFFERENT topology (stage 0 pure-DP mesh)
+    eng_b, *_ = dst.initialize(model=SimpleModel(16), config=CFG_B)
+    load_universal_into_engine(eng_b, uni_dir)
+    assert eng_b.global_steps == 3
+    resumed = [float(eng_b.train_batch(batch)) for _ in range(2)]
+    np.testing.assert_allclose(resumed, expected, rtol=1e-4)
+
+
+def test_universal_strict_missing_atom(tmp_path):
+    eng, *_ = dst.initialize(model=SimpleModel(16), config=CFG_B)
+    eng.train_batch(_batch())
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    uni = ds_to_universal(str(tmp_path / "ck"), tag="t")
+    # a bigger model must be rejected (atoms are global arrays)
+    eng2, *_ = dst.initialize(model=SimpleModel(24), config=CFG_B)
+    with pytest.raises((KeyError, ValueError)):
+        load_universal_into_engine(eng2, uni)
