@@ -130,3 +130,49 @@ def quantized_all_gather(x: jax.Array, axis_name: str,
     per = q.size  # padded elements per rank
     chunks = flat.reshape(p, per)[:, :n] if pad else flat.reshape(p, n)
     return chunks.reshape((p * x.shape[0],) + x.shape[1:]).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def quantized_all_gather_st(x: jax.Array, axis_name: str,
+                            block: int = BLOCK) -> jax.Array:
+    """Straight-through :func:`quantized_all_gather` (ZeRO++ qwZ):
+    forward gathers int8-compressed shards; backward is the exact
+    all-gather transpose (tiled psum-scatter of the cotangent), i.e. the
+    quantization error is treated straight-through.  For use inside
+    ``shard_map`` weight-gather paths."""
+    return quantized_all_gather(x, axis_name, block)
+
+
+def _qag_st_fwd(x, axis_name, block):
+    return quantized_all_gather(x, axis_name, block), None
+
+
+def _qag_st_bwd(axis_name, block, _res, ct):
+    return (lax.psum_scatter(ct, axis_name, scatter_dimension=0,
+                             tiled=True),)
+
+
+quantized_all_gather_st.defvjp(_qag_st_fwd, _qag_st_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def quantize_dequantize_st(x: jax.Array, bits: int = 8,
+                           block: int = BLOCK) -> jax.Array:
+    """Straight-through blockwise fake quantization: forward snaps to the
+    int8 grid (the numerics every qwZ-gathered weight sees), gradient
+    passes through unchanged.  The engine uses this for
+    ``zero_quantized_weights`` so training matches the reference's qwZ
+    accuracy behavior; the wire-compressed gather itself is the
+    ``quantized_all_gather_st`` op for shard_map paths."""
+    return quantize_dequantize(x, block=block)
+
+
+def _qdq_st_fwd(x, bits, block):
+    return quantize_dequantize(x, block=block), None
+
+
+def _qdq_st_bwd(bits, block, _res, ct):
+    return (ct,)
+
+
+quantize_dequantize_st.defvjp(_qdq_st_fwd, _qdq_st_bwd)

@@ -40,11 +40,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils.logging import logger
 
 # Canonical axis order, outermost first.
-MESH_AXES: Tuple[str, ...] = ("pipe", "data", "expert", "fsdp", "seq", "tensor")
+MESH_AXES: Tuple[str, ...] = ("pipe", "data", "expert", "fsdp", "hpz", "seq", "tensor")
 
 # Composite axis groups used for common shardings.
-BATCH_AXES = ("data", "expert", "fsdp")  # batch dim of inputs
-GRAD_REDUCE_AXES = ("data", "expert", "fsdp", "seq")  # dp_world for grad psum
+BATCH_AXES = ("data", "expert", "fsdp", "hpz")  # batch dim of inputs
+GRAD_REDUCE_AXES = ("data", "expert", "fsdp", "hpz", "seq")  # dp_world for grad psum
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +54,10 @@ class TopologyConfig:
     data: int = -1
     expert: int = 1
     fsdp: int = 1
+    # ZeRO++ hpZ secondary partition: an INNER shard axis placed on
+    # ICI-adjacent devices; stage-3 per-layer gathers ride only this axis
+    # while optimizer state shards over fsdp x hpz (see zero/partitioner).
+    hpz: int = 1
     seq: int = 1
     tensor: int = 1
 
@@ -122,6 +126,10 @@ class MeshTopology:
     @property
     def fsdp_world_size(self) -> int:
         return self.config.fsdp
+
+    @property
+    def hpz_world_size(self) -> int:
+        return self.config.hpz
 
     @property
     def dp_world_size(self) -> int:

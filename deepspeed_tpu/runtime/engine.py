@@ -91,19 +91,32 @@ def _topology_from_config(config: DeepSpeedTPUConfig,
         tensor=mesh_cfg.get("tensor", config.tensor_parallel.tp_size
                             if config.tensor_parallel.enabled else 1),
     )
+    zcfg = config.zero_optimization
+    hpz = max(1, int(mesh_cfg.get("hpz", zcfg.zero_hpz_partition_size)))
+    tcfg = dataclasses.replace(tcfg, hpz=hpz)
     n = len(devices) if devices is not None else jax.device_count()
     # ZeRO wants the fsdp axis to absorb data-parallel devices. If the user
     # didn't lay out the mesh explicitly, put all free devices on 'fsdp' for
     # stage>=1 (equivalent DP semantics, enables sharding), else on 'data'.
     if "data" not in mesh_cfg and "fsdp" not in mesh_cfg:
-        fixed = tcfg.pipe * tcfg.expert * tcfg.seq * tcfg.tensor
+        fixed = tcfg.pipe * tcfg.expert * tcfg.hpz * tcfg.seq * tcfg.tensor
         if fixed == 0 or n % fixed != 0:
             raise ValueError(
-                f"mesh axes pipe={tcfg.pipe} expert={tcfg.expert} seq={tcfg.seq} "
-                f"tensor={tcfg.tensor} (product {fixed}) do not divide "
-                f"device count {n}")
+                f"mesh axes pipe={tcfg.pipe} expert={tcfg.expert} "
+                f"hpz={tcfg.hpz} seq={tcfg.seq} tensor={tcfg.tensor} "
+                f"(product {fixed}) do not divide device count {n}")
         free = n // fixed
-        if config.zero_optimization.stage >= 1:
+        if zcfg.mics_shard_size > 0 and zcfg.stage >= 3:
+            # MiCS (reference zero/mics.py:64): shard params only WITHIN
+            # groups of mics_shard_size, replicate across groups — the
+            # cross-group axis is plain data parallelism
+            mics = zcfg.mics_shard_size
+            if free % mics != 0:
+                raise ValueError(
+                    f"mics_shard_size {mics} does not divide the {free} "
+                    f"free devices")
+            tcfg = dataclasses.replace(tcfg, data=free // mics, fsdp=mics)
+        elif zcfg.stage >= 1:
             tcfg = dataclasses.replace(tcfg, data=1, fsdp=free)
         else:
             tcfg = dataclasses.replace(tcfg, data=free, fsdp=1)
@@ -402,10 +415,25 @@ class DeepSpeedEngine:
         param_specs = partitioner.tree_param_specs(self._abstract_params)
         gspecs = partitioner.tree_grad_specs(self._abstract_params)
 
+        # ZeRO++ qwZ (zero_quantized_weights): compute weights snap to the
+        # int8 blockwise grid before use, reproducing the numerics of the
+        # reference's quantized weight all-gather (the wire-compressed
+        # gather op itself is ops.quantized_all_gather_st for shard_map
+        # paths; under GSPMD the gather is compiler-inserted, so the grid
+        # projection is where qwZ's accuracy behavior lives).
+        qw = (self.zero_stage >= 3
+              and cfg.zero_optimization.zero_quantized_weights)
+        if qw:
+            from ..ops.quantization import quantize_dequantize_st
+
         def cast_for_compute(p):
-            return jax.tree.map(
-                lambda x: x.astype(compute_dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+            def one(x):
+                if not jnp.issubdtype(x.dtype, jnp.floating):
+                    return x
+                if qw and x.ndim >= 2:
+                    x = quantize_dequantize_st(x)
+                return x.astype(compute_dtype)
+            return jax.tree.map(one, p)
 
         def constrain(tree, specs):
             return jax.tree.map(

@@ -72,13 +72,17 @@ def _largest_divisible_dim(shape: Tuple[int, ...], divisor: int,
 
 def add_fsdp_axis(spec: P, shape: Tuple[int, ...], fsdp_size: int,
                   min_size: int = 2 ** 12,
-                  blocked_dims: Optional[set] = None) -> P:
-    """Augment a (possibly tensor-parallel) spec with 'fsdp' sharding on the
+                  blocked_dims: Optional[set] = None,
+                  axes: Tuple[str, ...] = ("fsdp",)) -> P:
+    """Augment a (possibly tensor-parallel) spec with ZeRO sharding on the
     largest still-unsharded divisible dim.  Tiny params (< min_size elems,
     cf. stage3_param_persistence_threshold) stay replicated — gathering
     them is cheaper than the latency of a tiny collective.
     ``blocked_dims``: dims that must stay unsharded (e.g. the stacked
-    'layers' dim that lax.scan slices per iteration)."""
+    'layers' dim that lax.scan slices per iteration).
+    ``axes``: which mesh axes shard the dim — ("fsdp",) for plain ZeRO,
+    ("fsdp", "hpz") for the hpZ primary partition, ("hpz",) for the hpZ
+    secondary (compute) partition."""
     if fsdp_size <= 1:
         return spec
     if int(np.prod(shape)) < min_size:
@@ -90,7 +94,7 @@ def add_fsdp_axis(spec: P, shape: Tuple[int, ...], fsdp_size: int,
     dim = _largest_divisible_dim(shape, fsdp_size, taken)
     if dim is None:
         return spec
-    entries[dim] = "fsdp"
+    entries[dim] = axes if len(axes) > 1 else axes[0]
     return P(*entries)
 
 
@@ -171,23 +175,43 @@ class ZeroPartitioner:
         return {i for i, n in enumerate(names) if n == "layers"}
 
     def param_spec(self, leaf: Any) -> P:
-        """Sharding of the model parameters used in fwd/bwd."""
+        """Sharding of the model parameters used in fwd/bwd.
+
+        ZeRO++ hpZ (reference ``zero_hpz_partition_size``,
+        ``_partition_param_sec`` partition_parameters.py:1653): with an
+        'hpz' mesh axis, compute params shard over ONLY the inner 'hpz'
+        axis — the per-layer just-in-time gathers then ride ICI-adjacent
+        devices, while the once-per-step master->compute reshard carries
+        the cross-'fsdp' (DCN) traffic a single time."""
         spec = self._base_spec(leaf)
         shape = np.shape(getattr(leaf, "value", leaf))
         if self.stage >= 3:
-            spec = add_fsdp_axis(spec, shape, self.topology.fsdp_world_size,
-                                 self.persistence_threshold,
-                                 blocked_dims=self._blocked_dims(leaf))
+            hpz = self.topology.hpz_world_size
+            if hpz > 1:
+                spec = add_fsdp_axis(spec, shape, hpz,
+                                     self.persistence_threshold,
+                                     blocked_dims=self._blocked_dims(leaf),
+                                     axes=("hpz",))
+            else:
+                spec = add_fsdp_axis(spec, shape,
+                                     self.topology.fsdp_world_size,
+                                     self.persistence_threshold,
+                                     blocked_dims=self._blocked_dims(leaf))
         return spec
 
     def master_spec(self, leaf: Any) -> P:
-        """Sharding of fp32 master weights + optimizer moments."""
+        """Sharding of fp32 master weights + optimizer moments: always the
+        FULL zero partition (fsdp x hpz under ZeRO++)."""
         spec = self._base_spec(leaf)
         shape = np.shape(getattr(leaf, "value", leaf))
         if self.stage >= 1:
-            spec = add_fsdp_axis(spec, shape, self.topology.fsdp_world_size,
+            hpz = self.topology.hpz_world_size
+            total = self.topology.fsdp_world_size * hpz
+            axes = ("fsdp", "hpz") if hpz > 1 else ("fsdp",)
+            spec = add_fsdp_axis(spec, shape, total,
                                  min_size=2,  # shard even small opt state
-                                 blocked_dims=self._blocked_dims(leaf))
+                                 blocked_dims=self._blocked_dims(leaf),
+                                 axes=axes)
         return spec
 
     def grad_spec(self, leaf: Any) -> P:
