@@ -189,11 +189,27 @@ def is_initialized() -> bool:
 
 
 def get_rank() -> int:
+    """Host-process rank.  The reference's rank==GPU==process identity
+    splits on TPU (one process drives many chips): host-side concerns
+    (logging, file writes, rendezvous) key on the PROCESS, device-level
+    parallelism on the DEVICE — use get_device_count()/get_device_rank()
+    for the latter.  rank/world pairs are always consistent."""
     return jax.process_index()
 
 
 def get_world_size() -> int:
-    """Device world size (the reference's world == ranks == devices)."""
+    """Number of host processes (consistent with get_rank)."""
+    return jax.process_count()
+
+
+def get_device_rank() -> int:
+    """Global index of this process's first addressable device."""
+    local = jax.local_devices()
+    return local[0].id if local else 0
+
+
+def get_device_count() -> int:
+    """Device world size (the unit of SPMD parallelism on TPU)."""
     return jax.device_count()
 
 

@@ -148,18 +148,24 @@ class InferenceEngine:
         """Batch generation (reference ``InferenceEngine.generate`` :609
         guard rails: bounded output length, input validation)."""
         prompts = self._normalize_prompts(input_ids)
+        # HF semantics: max_length caps each sequence's TOTAL length, so
+        # the new-token budget is per-prompt (a short prompt may generate
+        # more tokens than a long one, and no sequence overruns the cap).
         if max_length is not None:
-            max_new_tokens = max(self.config.min_out_tokens,
-                                 max_length - min(len(p) for p in prompts))
-        if max_new_tokens > self.config.max_out_tokens:
-            raise ValueError(
-                f"max_new_tokens {max_new_tokens} exceeds engine "
-                f"max_out_tokens {self.config.max_out_tokens}")
-        params = SamplingParams(
-            max_new_tokens=int(max_new_tokens),
+            budgets = [max(self.config.min_out_tokens, max_length - len(p))
+                       for p in prompts]
+        else:
+            budgets = [int(max_new_tokens)] * len(prompts)
+        for b in budgets:
+            if b > self.config.max_out_tokens:
+                raise ValueError(
+                    f"max_new_tokens {b} exceeds engine "
+                    f"max_out_tokens {self.config.max_out_tokens}")
+        params = [SamplingParams(
+            max_new_tokens=int(b),
             temperature=float(temperature) if do_sample else 0.0,
             top_k=int(top_k), top_p=float(top_p),
-            stop_token=eos_token_id)
+            stop_token=eos_token_id) for b in budgets]
         outs = _ragged_generate(self._engine, prompts, params,
                                 token_budget=self.config.max_tokens_per_batch)
         return outs

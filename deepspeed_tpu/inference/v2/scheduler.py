@@ -202,9 +202,14 @@ class FastGenScheduler:
 def generate(engine: InferenceEngineV2, prompts: Sequence[Sequence[int]],
              params: Optional[SamplingParams] = None,
              token_budget: Optional[int] = None) -> List[List[int]]:
-    """Batch generation convenience over the scheduler."""
+    """Batch generation convenience over the scheduler.  ``params`` may be
+    a single SamplingParams for all prompts or one per prompt."""
     sched = FastGenScheduler(engine, token_budget=token_budget)
-    for i, p in enumerate(prompts):
-        sched.submit(i, p, params)
+    per_prompt = (list(params) if isinstance(params, (list, tuple))
+                  else [params] * len(prompts))
+    if len(per_prompt) != len(prompts):
+        raise ValueError(f"{len(per_prompt)} params for {len(prompts)} prompts")
+    for i, (p, sp) in enumerate(zip(prompts, per_prompt)):
+        sched.submit(i, p, sp)
     results = sched.run_to_completion()
     return [results[i] for i in range(len(prompts))]

@@ -96,6 +96,17 @@ def main(argv=None) -> int:
                 node_rank, len(rank_envs), args.user_script)
 
     procs: List[subprocess.Popen] = []
+
+    # Handlers installed BEFORE the spawn loop: a SIGINT/SIGTERM arriving
+    # while children are still being spawned must terminate the ones
+    # already started (the closure sees each Popen as it is appended).
+    def _terminate(signum, frame):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+
     user_args = list(args.user_args)
     if user_args and user_args[0] == "--":
         user_args = user_args[1:]
@@ -104,13 +115,6 @@ def main(argv=None) -> int:
         cmd = [sys.executable, "-u", args.user_script,
                f"--local_rank={env_delta['LOCAL_RANK']}"] + user_args
         procs.append(subprocess.Popen(cmd, env=env))
-
-    def _terminate(signum, frame):
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-    signal.signal(signal.SIGINT, _terminate)
-    signal.signal(signal.SIGTERM, _terminate)
 
     # Wait; on any child failure, kill the rest and propagate its code.
     exit_code = 0

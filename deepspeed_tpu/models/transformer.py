@@ -59,6 +59,11 @@ class TransformerConfig:
     scan_layers: bool = True
     remat: bool = True
     remat_policy: str = "nothing_saveable"
+    # auto: Pallas flash kernel whenever the mask is pure-causal (TPU;
+    # jnp reference off-TPU) | flash: force | einsum: dense path
+    attention_impl: str = "auto"
+    flash_block_q: int = 512
+    flash_block_k: int = 512
     dtype: Any = jnp.bfloat16
 
     @property
@@ -205,13 +210,79 @@ def _activation(cfg: TransformerConfig, gate, up):
     return jax.nn.gelu(up)
 
 
+def _ambient_mesh():
+    """The Mesh active at trace time (None when single-device/absent)."""
+    try:
+        from jax.interpreters import pxla
+        m = pxla.thread_resources.env.physical_mesh
+        if m is not None and not m.empty and m.devices.size > 1:
+            return m
+    except Exception:
+        pass
+    return None
+
+
+def flash_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v) -> jax.Array:
+    """Causal attention via the Pallas flash kernel (ops/flash_attention.py).
+
+    q: [B,S,H,D], k/v: [B,S,K,D] -> [B,S,H,D].  Replaces the reference's
+    fused attention kernels (csrc/transformer/ softmax+attention CUDA) on
+    the training path: no [B,H,S,S] score tensor ever reaches HBM.
+
+    GQA folds kv heads up to H per shard.  Under a >1-device mesh the
+    kernel runs inside shard_map (batch over the batch axes, heads over
+    'seq'+'tensor' — the Ulysses layout), since GSPMD cannot partition a
+    pallas_call on its own.
+    """
+    from ..ops.flash_attention import flash_attention
+
+    qf = q.transpose(0, 2, 1, 3)      # [B,H,S,D]
+    kf = kv_k.transpose(0, 2, 1, 3)   # [B,K,S,D]
+    vf = kv_v.transpose(0, 2, 1, 3)
+
+    def per_shard(qs, ks, vs):
+        groups = qs.shape[1] // ks.shape[1]
+        if groups > 1:
+            ks = jnp.repeat(ks, groups, axis=1)
+            vs = jnp.repeat(vs, groups, axis=1)
+        return flash_attention(qs, ks, vs, causal=True,
+                               block_q=cfg.flash_block_q,
+                               block_k=cfg.flash_block_k)
+
+    mesh = _ambient_mesh()
+    if mesh is not None:
+        from jax.experimental.shard_map import shard_map
+        batch_axes = tuple(a for a in BATCH if a in mesh.axis_names)
+        head_axes = tuple(a for a in ("seq", "tensor") if a in mesh.axis_names)
+        spec = P(batch_axes or None, head_axes or None, None, None)
+        out = shard_map(per_shard, mesh=mesh,
+                        in_specs=(spec, spec, spec), out_specs=spec,
+                        check_rep=False)(qf, kf, vf)
+    else:
+        out = per_shard(qf, kf, vf)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _flash_ok(cfg: TransformerConfig, n_heads: int, n_kv: int) -> bool:
+    """Trace-time check that the flash layout divides the active mesh."""
+    mesh = _ambient_mesh()
+    if mesh is None:
+        return True
+    head_shards = 1
+    for a in ("seq", "tensor"):
+        if a in mesh.axis_names:
+            head_shards *= mesh.shape[a]
+    return (n_heads % head_shards == 0 and n_kv % head_shards == 0
+            and head_shards <= n_kv)
+
+
 def dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v,
                           mask: Optional[jax.Array]) -> jax.Array:
     """Grouped-query attention, fp32 softmax.  q: [B,S,H,D], k/v: [B,S,K,D].
 
-    Hot op #1 (reference csrc/transformer softmax/attention kernels); the
-    Pallas flash kernel in ops/flash_attention.py replaces this einsum
-    formulation on TPU when seq_len crosses the flash threshold.
+    Hot op #1 (reference csrc/transformer softmax/attention kernels).
+    This dense einsum formulation serves arbitrary masks and non-TPU CI;
+    the pure-causal training path uses flash_dot_product_attention.
     """
     b, s, hq, dd = q.shape
     k_heads = kv_k.shape[2]
@@ -226,7 +297,8 @@ def dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v,
     return out.reshape(b, s, hq, dd)
 
 
-def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask):
+def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
+                     use_flash: bool = False):
     dtype = cfg.dtype
     wq, wk, wv, wo = (p["wq"].astype(dtype), p["wk"].astype(dtype),
                       p["wv"].astype(dtype), p["wo"].astype(dtype))
@@ -246,7 +318,10 @@ def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask):
     q = _constrain(q, BATCH, None, ("seq", "tensor"), None)
     k = _constrain(k, BATCH, None, ("seq", "tensor") if cfg.kv_heads > 1 else None, None)
     v = _constrain(v, BATCH, None, ("seq", "tensor") if cfg.kv_heads > 1 else None, None)
-    out = dot_product_attention(cfg, q, k, v, mask)
+    if use_flash:
+        out = flash_dot_product_attention(cfg, q, k, v)
+    else:
+        out = dot_product_attention(cfg, q, k, v, mask)
     out = jnp.einsum("bshd,hde->bse", out, wo)
     if cfg.use_bias:
         out = out + p["bo"].astype(dtype)
@@ -269,11 +344,12 @@ def _mlp_block(cfg: TransformerConfig, p, x):
 
 
 def _layer_body(cfg: TransformerConfig, layer_params, x, sin, cos, mask,
-                mlp_fn=None):
+                mlp_fn=None, use_flash: bool = False):
     """Returns (x, aux) — aux is 0 for dense MLPs, the load-balancing loss
     for MoE mlp_fns (accumulated through the layer scan)."""
     h = _norm_apply(cfg, layer_params["norm1"], x)
-    x = x + _attention_block(cfg, layer_params["attn"], h, sin, cos, mask)
+    x = x + _attention_block(cfg, layer_params["attn"], h, sin, cos, mask,
+                             use_flash=use_flash)
     h = _norm_apply(cfg, layer_params["norm2"], x)
     mlp_out = (mlp_fn or _mlp_block)(cfg, layer_params["mlp"], h)
     aux = jnp.zeros((), jnp.float32)
@@ -299,6 +375,20 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
     returns (logits, accumulated MoE aux loss)."""
     params = meta.unbox(params) if _has_boxes(params) else params
     b, s = input_ids.shape
+
+    # Flash is valid only for the standard dense-causal case: default
+    # positions (no packing) and no padding mask.  Decided at trace time.
+    use_flash = (cfg.attention_impl != "einsum"
+                 and cfg.causal
+                 and attention_mask is None
+                 and positions is None
+                 and s > 1
+                 and _flash_ok(cfg, cfg.num_heads, cfg.kv_heads))
+    if cfg.attention_impl == "flash" and not use_flash:
+        raise ValueError(
+            "attention_impl='flash' requires causal attention with default "
+            "positions, no attention_mask, and a mesh the head layout divides")
+
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
@@ -307,19 +397,23 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
         x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
     x = _constrain(x, BATCH, "seq", None)
 
-    # mask: [B, S(q), S(k)]
-    if cfg.causal:
-        causal = positions[:, :, None] >= positions[:, None, :]
-        mask = causal
+    # mask: [B, S(q), S(k)]  (not needed on the flash path — the kernel
+    # applies causality blockwise)
+    if use_flash:
+        mask = None
+    elif cfg.causal:
+        mask = positions[:, :, None] >= positions[:, None, :]
     else:
         mask = jnp.ones((b, s, s), bool)
-    if attention_mask is not None:
+    if attention_mask is not None and mask is not None:
         mask = mask & attention_mask[:, None, :].astype(bool)
 
     sin, cos = rope_table(cfg, positions) if cfg.pos_emb == "rope" else (None, None)
 
-    body = functools.partial(_layer_body, cfg, mlp_fn=mlp_fn) \
-        if mlp_fn is not None else functools.partial(_layer_body, cfg)
+    body = functools.partial(_layer_body, cfg, mlp_fn=mlp_fn,
+                             use_flash=use_flash) \
+        if mlp_fn is not None else functools.partial(_layer_body, cfg,
+                                                     use_flash=use_flash)
 
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:

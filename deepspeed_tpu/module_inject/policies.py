@@ -8,8 +8,10 @@ resolves a HuggingFace architecture to:
 * a :class:`~deepspeed_tpu.models.transformer.TransformerConfig`,
 * a weight-loading function (HF state_dict -> our param tree),
 * which makes "kernel injection" implicit — the functional transformer
-  already runs the fused TPU ops (flash attention, fused RMSNorm, RoPE)
-  that the reference's ``DeepSpeedTransformerInference`` containers swap in.
+  runs the Pallas flash kernel on the causal TPU path
+  (models/transformer.py flash_dot_product_attention) and leaves
+  RMSNorm/RoPE/bias-act to XLA fusion, covering what the reference's
+  ``DeepSpeedTransformerInference`` containers swap in.
 """
 
 from __future__ import annotations

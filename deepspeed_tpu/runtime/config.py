@@ -274,6 +274,9 @@ class TPUConfig(DeepSpeedConfigModel):
     scan_layers: bool = True
     remat: bool = True
     remat_policy: str = "nothing_saveable"  # maps to jax.checkpoint policies
+    # attention implementation: auto (flash when the mask allows it) |
+    # flash (force) | einsum (dense reference path)
+    attention_impl: str = "auto"
     donate_state: bool = True
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"

@@ -39,9 +39,22 @@ class DataAnalyzer:
         base = os.path.join(self.save_path, metric)
         return base + "_metric_values.npy", base + "_sample_to_metric.npy"
 
-    def run_map_reduce(self) -> None:
-        """Compute metrics over this worker's shard, then merge
-        (single-process path computes everything)."""
+    def _worker_path(self, metric: str, worker: int) -> str:
+        return os.path.join(self.save_path,
+                            f"{metric}_metric_values.worker{worker}.npy")
+
+    @staticmethod
+    def _atomic_save(path: str, arr: np.ndarray) -> None:
+        tmp = f"{path}.{os.getpid()}.tmp.npy"  # .npy suffix: np.save appends otherwise
+        np.save(tmp, arr)
+        os.replace(tmp, path)
+
+    def run_map(self) -> None:
+        """Compute metrics over this worker's shard into a per-worker file.
+
+        Each worker owns its file exclusively (no shared read-modify-write
+        — the reference's map/reduce split, data_analyzer.py run_map), so
+        concurrent workers cannot lose updates."""
         os.makedirs(self.save_path, exist_ok=True)
         n = len(self.dataset)
         shard = range(self.worker_id, n, self.num_workers)
@@ -49,16 +62,43 @@ class DataAnalyzer:
             values = np.full(n, np.nan, np.float64)
             for i in shard:
                 values[i] = float(fn(self.dataset[i]))
+            self._atomic_save(self._worker_path(name, self.worker_id), values)
+
+    def run_reduce(self, strict: bool = True) -> bool:
+        """Merge all workers' shard files into the final metric files.
+
+        Idempotent and deterministic: whichever worker(s) see the full set
+        of shard files write byte-identical output via atomic rename.
+        Returns True if the merge completed."""
+        done = True
+        for name in self.metric_fns:
+            paths = [self._worker_path(name, w) for w in range(self.num_workers)]
+            missing = [p for p in paths if not os.path.exists(p)]
+            if missing:
+                if strict:
+                    raise FileNotFoundError(
+                        f"DataAnalyzer reduce: missing worker shards {missing}")
+                done = False
+                continue
+            values = np.load(paths[0])
+            for p in paths[1:]:
+                shard_vals = np.load(p)
+                mask = ~np.isnan(shard_vals)
+                values[mask] = shard_vals[mask]
             vals_path, s2m_path = self._paths(name)
-            if self.num_workers > 1 and os.path.exists(vals_path):
-                prev = np.load(vals_path)
-                mask = ~np.isnan(prev)
-                values[mask] = prev[mask]
-            np.save(vals_path, values)
+            self._atomic_save(vals_path, values)
             if not np.isnan(values).any():
-                np.save(s2m_path, np.argsort(values, kind="stable"))
-        logger.info("DataAnalyzer: wrote metrics %s to %s",
-                    sorted(self.metric_fns), self.save_path)
+                self._atomic_save(s2m_path, np.argsort(values, kind="stable"))
+        return done
+
+    def run_map_reduce(self) -> None:
+        """Map this worker's shard, then merge if every shard is present
+        (the last worker to finish completes the merge; single-process
+        path computes everything)."""
+        self.run_map()
+        if self.run_reduce(strict=False):
+            logger.info("DataAnalyzer: wrote metrics %s to %s",
+                        sorted(self.metric_fns), self.save_path)
 
     @staticmethod
     def load(save_path: str, metric: str):

@@ -19,9 +19,11 @@ import numpy as np
 _INDEX_MAGIC = b"MMIDIDX\x00\x00"
 _VERSION = 1
 
-# dtype codes from the Megatron format
+# dtype codes from the Megatron format (reference
+# data_sampling/indexed_dataset.py:102 — 6/7/8 are the unsigned widths;
+# uint16 corpora are the common vocab<=65536 case)
 _DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
-           5: np.int64, 6: np.float64, 7: np.float32, 8: np.uint16}
+           5: np.int64, 6: np.uint16, 7: np.uint32, 8: np.uint64}
 _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
 
 

@@ -147,6 +147,7 @@ class DeepSpeedEngine:
                  dont_change_device: bool = False):
         self.config = load_config(config)
         self.module = model
+        self._apply_model_overrides()
         dist.init_distributed()
         self.topology = topology or _topology_from_config(self.config)
         self.config.resolve_batch_sizes(self.topology.batch_shard_size)
@@ -234,6 +235,26 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # construction helpers
     # ------------------------------------------------------------------
+    def _apply_model_overrides(self) -> None:
+        """Propagate explicitly-set ``tpu.*`` model knobs (scan_layers,
+        remat, remat_policy, attention_impl) onto the model's
+        TransformerConfig.  Only keys the user actually wrote in the
+        engine config are applied, so model-constructor overrides win
+        otherwise."""
+        model = self.module
+        if model is None or not hasattr(model, "cfg"):
+            return
+        from ..models.transformer import TransformerConfig
+        if not isinstance(model.cfg, TransformerConfig):
+            return
+        tpu = self.config.tpu
+        overrides = {k: getattr(tpu, k)
+                     for k in ("scan_layers", "remat", "remat_policy",
+                               "attention_impl")
+                     if k in tpu.model_fields_set}
+        if overrides:
+            model.cfg = dataclasses.replace(model.cfg, **overrides)
+
     def _build_optimizer(self, opt_cfg) -> optax.GradientTransformation:
         return get_optimizer(opt_cfg.type, opt_cfg.params,
                              lr_schedule=lambda count: self._traced_lr(count))

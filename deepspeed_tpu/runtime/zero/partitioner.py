@@ -73,7 +73,8 @@ def _largest_divisible_dim(shape: Tuple[int, ...], divisor: int,
 def add_fsdp_axis(spec: P, shape: Tuple[int, ...], fsdp_size: int,
                   min_size: int = 2 ** 12,
                   blocked_dims: Optional[set] = None,
-                  axes: Tuple[str, ...] = ("fsdp",)) -> P:
+                  axes: Tuple[str, ...] = ("fsdp",),
+                  axis_sizes: Optional[Tuple[int, ...]] = None) -> P:
     """Augment a (possibly tensor-parallel) spec with ZeRO sharding on the
     largest still-unsharded divisible dim.  Tiny params (< min_size elems,
     cf. stage3_param_persistence_threshold) stay replicated — gathering
@@ -82,7 +83,11 @@ def add_fsdp_axis(spec: P, shape: Tuple[int, ...], fsdp_size: int,
     'layers' dim that lax.scan slices per iteration).
     ``axes``: which mesh axes shard the dim — ("fsdp",) for plain ZeRO,
     ("fsdp", "hpz") for the hpZ primary partition, ("hpz",) for the hpZ
-    secondary (compute) partition."""
+    secondary (compute) partition.  ``axis_sizes`` (parallel to ``axes``)
+    enables degrading to a prefix of the axes when the full product does
+    not divide any dim — never a silent full replication of a large leaf
+    (cf. reference _partition_param_sec divisibility assert,
+    partition_parameters.py:1653)."""
     if fsdp_size <= 1:
         return spec
     if int(np.prod(shape)) < min_size:
@@ -92,9 +97,28 @@ def add_fsdp_axis(spec: P, shape: Tuple[int, ...], fsdp_size: int,
     if blocked_dims:
         taken |= blocked_dims
     dim = _largest_divisible_dim(shape, fsdp_size, taken)
+    use_axes = axes
+    if dim is None and axis_sizes is not None and len(axes) > 1:
+        for cut in range(len(axes) - 1, 0, -1):
+            sub_size = int(np.prod(axis_sizes[:cut]))
+            if sub_size <= 1:
+                continue
+            dim = _largest_divisible_dim(shape, sub_size, taken)
+            if dim is not None:
+                use_axes = axes[:cut]
+                logger.warning(
+                    "zero partitioner: shape %s not divisible by the full "
+                    "%s=%d partition; degrading to %s=%d (leaf stays "
+                    "replicated over %s)", shape, axes, fsdp_size,
+                    use_axes, sub_size, axes[cut:])
+                break
     if dim is None:
+        logger.warning(
+            "zero partitioner: no dim of shape %s divisible by %d on axes "
+            "%s — leaf stays REPLICATED (memory savings lost)",
+            shape, fsdp_size, axes)
         return spec
-    entries[dim] = axes if len(axes) > 1 else axes[0]
+    entries[dim] = use_axes if len(use_axes) > 1 else use_axes[0]
     return P(*entries)
 
 

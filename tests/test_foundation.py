@@ -94,8 +94,12 @@ class TestComm:
         np.testing.assert_allclose(out, [3, 0, 1, 2])
 
     def test_host_info(self):
-        assert dist.get_world_size() == 8
+        # rank/world must be a consistent pair (process-level); device
+        # parallelism is exposed separately.
+        assert dist.get_world_size() == 1
         assert dist.get_rank() == 0
+        assert dist.get_device_count() == 8
+        assert dist.get_device_rank() == 0
 
 
 class TestConfig:
