@@ -34,7 +34,7 @@ import numpy as np
 from flax.core import meta
 from jax.sharding import PartitionSpec as P
 
-BATCH = ("data", "expert", "fsdp")  # batch-dim mesh axes (topology.BATCH_AXES)
+from ..parallel.topology import BATCH_AXES as BATCH  # batch-dim mesh axes
 
 
 @dataclasses.dataclass(frozen=True)
@@ -263,8 +263,13 @@ def flash_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v) -> jax.Ar
     return out.transpose(0, 2, 1, 3)
 
 
-def _flash_ok(cfg: TransformerConfig, n_heads: int, n_kv: int) -> bool:
-    """Trace-time check that the flash layout divides the active mesh."""
+def _flash_ok(cfg: TransformerConfig, n_heads: int, n_kv: int,
+              batch: Optional[int] = None) -> bool:
+    """Trace-time check that the flash layout divides the active mesh.
+
+    Unlike the einsum path (where GSPMD pads awkward shapes), shard_map
+    requires exact divisibility of both the head layout over
+    ('seq','tensor') and — when known — the batch over the batch axes."""
     mesh = _ambient_mesh()
     if mesh is None:
         return True
@@ -272,6 +277,13 @@ def _flash_ok(cfg: TransformerConfig, n_heads: int, n_kv: int) -> bool:
     for a in ("seq", "tensor"):
         if a in mesh.axis_names:
             head_shards *= mesh.shape[a]
+    if batch is not None:
+        batch_shards = 1
+        for a in BATCH:
+            if a in mesh.axis_names:
+                batch_shards *= mesh.shape[a]
+        if batch % batch_shards != 0:
+            return False
     return (n_heads % head_shards == 0 and n_kv % head_shards == 0
             and head_shards <= n_kv)
 
@@ -383,7 +395,7 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
                  and attention_mask is None
                  and positions is None
                  and s > 1
-                 and _flash_ok(cfg, cfg.num_heads, cfg.kv_heads))
+                 and _flash_ok(cfg, cfg.num_heads, cfg.kv_heads, batch=b))
     if cfg.attention_impl == "flash" and not use_flash:
         raise ValueError(
             "attention_impl='flash' requires causal attention with default "

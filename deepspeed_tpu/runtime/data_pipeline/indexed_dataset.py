@@ -64,6 +64,18 @@ class MMapIndexedDataset:
                                      count=doc_count, offset=doc_off)
         self._data = np.memmap(data_file_path(path_prefix), mode="r",
                                dtype=self.dtype, order="C")
+        # Integrity check: the .bin must hold exactly the tokens the index
+        # promises.  Catches indices written with a wrong dtype code (e.g.
+        # by pre-r3 builds of this repo, whose codes 6/8 were swapped vs
+        # Megatron — a uint16 corpus misread as uint64 fails here 4x over)
+        # instead of silently decoding garbage.
+        expected = int(self.pointers[-1]) // self.dtype.itemsize \
+            + int(self.sizes[-1]) if self._len else 0
+        if self._data.size != expected:
+            raise ValueError(
+                f"{data_file_path(path_prefix)}: {self._data.size} items of "
+                f"{self.dtype} but index promises {expected} — dtype code "
+                "mismatch (index written by an incompatible builder?)")
 
     def __len__(self) -> int:
         return self._len

@@ -232,10 +232,12 @@ class ZeroPartitioner:
             hpz = self.topology.hpz_world_size
             total = self.topology.fsdp_world_size * hpz
             axes = ("fsdp", "hpz") if hpz > 1 else ("fsdp",)
+            sizes = ((self.topology.fsdp_world_size, hpz) if hpz > 1
+                     else (self.topology.fsdp_world_size,))
             spec = add_fsdp_axis(spec, shape, total,
                                  min_size=2,  # shard even small opt state
                                  blocked_dims=self._blocked_dims(leaf),
-                                 axes=axes)
+                                 axes=axes, axis_sizes=sizes)
         return spec
 
     def grad_spec(self, leaf: Any) -> P:
