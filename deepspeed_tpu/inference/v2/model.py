@@ -23,7 +23,8 @@ from jax.sharding import PartitionSpec as P
 
 from ...models import transformer as T
 from ...ops.paged_attention import (gather_last, paged_attention,
-                                    token_positions, write_kv)
+                                    rope_write_kv, token_positions,
+                                    write_kv)
 from .ragged import KVCacheConfig, RaggedBatch
 
 
@@ -153,8 +154,11 @@ class RaggedInferenceModel:
             v = v + ap["bv"].astype(dtype)
         if cfg.pos_emb == "rope":
             q = T.apply_rope(q, sin, cos)
-            k = T.apply_rope(k, sin, cos)
-        kv_layer = write_kv(kv_layer, k, v, page_table, start_pos, q_lens)
+            kv_layer = rope_write_kv(kv_layer, k, v, sin, cos, page_table,
+                                     start_pos, q_lens)
+        else:
+            kv_layer = write_kv(kv_layer, k, v, page_table, start_pos,
+                                q_lens)
         attn = paged_attention(q, kv_layer, page_table, start_pos, q_lens)
         out = jnp.einsum("sqhd,hde->sqe", attn, ap["wo"].astype(dtype))
         if cfg.use_bias:

@@ -64,6 +64,9 @@ class TransformerConfig:
     attention_impl: str = "auto"
     flash_block_q: int = 512
     flash_block_k: int = 512
+    # sparse embedding gradients (reference engine.py:2535 sparse
+    # allreduce): backward ships the [B*S,E] cotangent, not [V,E]
+    sparse_gradients: bool = False
     dtype: Any = jnp.bfloat16
 
     @property
@@ -404,7 +407,12 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
-    x = params["embed"]["tokens"].astype(cfg.dtype)[input_ids]
+    if cfg.sparse_gradients:
+        from ..runtime.sparse_tensor import embedding_lookup
+        x = embedding_lookup(params["embed"]["tokens"].astype(cfg.dtype),
+                             input_ids)
+    else:
+        x = params["embed"]["tokens"].astype(cfg.dtype)[input_ids]
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
     x = _constrain(x, BATCH, "seq", None)

@@ -14,18 +14,24 @@ to thread blocks; on TPU the ragged batch is instead padded to a static
                         dense einsum -> MXU, raggedness lives in masks.
 * ``gather_last``     — last-token hidden-state gather for logits.
 
-A Pallas kernel specializes the decode path (Q=1) to avoid
-materializing the gathered ``[S, C, K, D]`` context in HBM; the jnp
-formulation below is the semantics ground truth and the CPU/CI path.
+``paged_decode_attention`` is the Pallas specialization of the decode
+path (Q=1): a ``(slot, kv_head, page)`` grid whose BlockSpec index map
+reads the page table via scalar prefetch, so each KV page is DMA'd
+HBM->VMEM exactly once and the gathered ``[S, C, K, D]`` context never
+materializes in HBM.  The jnp formulation is the semantics ground truth
+and the CPU/CI path; ``paged_attention`` auto-selects.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+import functools
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
@@ -62,14 +68,29 @@ def write_kv(kv_layer: jax.Array, k_new: jax.Array, v_new: jax.Array,
 def paged_attention(q: jax.Array, kv_layer: jax.Array,
                     page_table: jax.Array, start_pos: jax.Array,
                     q_lens: jax.Array, *,
-                    sm_scale: float | None = None) -> jax.Array:
+                    sm_scale: float | None = None,
+                    use_kernel: Optional[bool] = None,
+                    interpret: bool = False) -> jax.Array:
     """Masked GQA attention of [S, Q] new tokens over their paged context.
 
     q        : [S, Q, H, D]    (H = K * groups)
     kv_layer : [num_pages+1, page_size, 2, K, D] (new KV already written)
     Returns  : [S, Q, H, D]
+
+    Decode steps (Q == 1) route to the Pallas kernel (``use_kernel``
+    None = auto: on TPU, or anywhere with ``interpret=True``);
+    everything else (prefill / mixed buckets) uses the dense-gather jnp
+    path.  ``interpret`` runs the kernel in Pallas interpret mode (CPU
+    testing), independent of path selection.
     """
     S, Q, H, D = q.shape
+    if Q == 1:
+        if use_kernel is None:
+            use_kernel = interpret or jax.default_backend() == "tpu"
+        if use_kernel:
+            return paged_decode_attention(
+                q, kv_layer, page_table, start_pos,
+                sm_scale=sm_scale, interpret=interpret)
     page_size = kv_layer.shape[1]
     K = kv_layer.shape[3]
     G = H // K
@@ -96,6 +117,130 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("skgqc,sckd->sqkgd", probs, v)
     return out.reshape(S, Q, H, D)
+
+
+# ---------------------------------------------------------------------------
+# Pallas decode kernel (Q = 1)
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(pt_ref, sp_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, page_size, num_pages_per_seq,
+                   sm_scale):
+    """One (slot, kv_head, page) grid step of flash-style decode.
+
+    q_ref : [G, D]         (this slot's queries for one kv head)
+    k_ref/v_ref : [page_size, D]  (one cache page, DMA'd via the page
+                            table — see the index maps in the caller)
+    Scratch m/l/acc carry the running max / denominator / weighted sum
+    across the page axis (the innermost, sequential grid dim).
+    """
+    s = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    ctx_len = sp_ref[s] + 1  # new token at start_pos is already in cache
+    page_valid = p * page_size < ctx_len
+
+    @pl.when(page_valid)
+    def _attend():
+        q = q_ref[:]                                   # [G, D]
+        k = k_ref[:]                                   # [page, D]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [G, page]
+        ctx = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(ctx < ctx_len, scores, MASK_VALUE)
+        m_prev = m_scr[:]                              # [G, 1]
+        l_prev = l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        pexp = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * alpha + jnp.sum(pexp, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pexp.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(p == num_pages_per_seq - 1)
+    def _finish():
+        o_ref[:] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
+                           page_table: jax.Array, start_pos: jax.Array, *,
+                           sm_scale: float | None = None,
+                           interpret: bool = False) -> jax.Array:
+    """Pallas decode attention: Q=1 queries over paged KV.
+
+    TPU-native counterpart of the reference's blocked_flash decode atoms
+    (``inference/v2/kernels/ragged_ops/atom_builder/`` splits sequences
+    into KV blocks per thread block; here the page IS the block and the
+    page table drives the BlockSpec index map through scalar prefetch).
+
+    q: [S, 1, H, D]; kv_layer: [num_pages+1, page_size, 2, K, D];
+    page_table: [S, P]; start_pos: [S].  Returns [S, 1, H, D].
+    """
+    S, Q, H, D = q.shape
+    assert Q == 1, "decode kernel is specialized to one new token per slot"
+    page_size = kv_layer.shape[1]
+    K = kv_layer.shape[3]
+    G = H // K
+    P_pages = page_table.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+
+    qg = q.reshape(S, K, G, D)  # fold GQA: per kv head, G queries
+
+    grid = (S, K, P_pages)
+    # index maps receive (s, k, p, *scalar_prefetch_refs)
+    q_spec = pl.BlockSpec((None, None, G, D), lambda s, k, p, pt, sp: (s, k, 0, 0))
+    k_spec = pl.BlockSpec((None, page_size, None, None, D),
+                          lambda s, k, p, pt, sp: (pt[s, p], 0, 0, k, 0))
+    v_spec = pl.BlockSpec((None, page_size, None, None, D),
+                          lambda s, k, p, pt, sp: (pt[s, p], 0, 1, k, 0))
+    o_spec = pl.BlockSpec((None, None, G, D), lambda s, k, p, pt, sp: (s, k, 0, 0))
+
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, num_pages_per_seq=P_pages,
+        sm_scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[q_spec, k_spec, v_spec],
+            out_specs=o_spec,
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, K, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), start_pos.astype(jnp.int32),
+      qg, kv_layer, kv_layer)
+    return out.reshape(S, Q, H, D)
+
+
+def rope_write_kv(kv_layer: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                  sin: jax.Array, cos: jax.Array, page_table: jax.Array,
+                  start_pos: jax.Array, q_lens: jax.Array) -> jax.Array:
+    """Fused rotary-embed + cache write (reference
+    ``linear_blocked_kv_rotary``, inference/v2/kernels/ragged_ops/
+    linear_blocked_kv_copy): one traced region XLA fuses into a single
+    rotate-and-scatter, so the rotated K never round-trips HBM."""
+    from ..models.transformer import apply_rope
+    return write_kv(kv_layer, apply_rope(k_new, sin, cos), v_new,
+                    page_table, start_pos, q_lens)
 
 
 def gather_last(x: jax.Array, q_lens: jax.Array) -> jax.Array:

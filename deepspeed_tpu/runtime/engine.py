@@ -252,6 +252,10 @@ class DeepSpeedEngine:
                      for k in ("scan_layers", "remat", "remat_policy",
                                "attention_impl")
                      if k in tpu.model_fields_set}
+        if self.config.sparse_gradients:
+            # reference top-level key: embedding grads take the sparse
+            # (indexed-slices) backward, runtime/sparse_tensor.py
+            overrides["sparse_gradients"] = True
         if overrides:
             model.cfg = dataclasses.replace(model.cfg, **overrides)
 
@@ -693,6 +697,10 @@ class DeepSpeedEngine:
                 self._apply_offload_step(off_grads,
                                          float(metrics["applied_lr"]))
         loss = float(metrics["loss"])
+        from ..tools.tensor_logger import record_active
+        # iteration stays the caller's (log_iteration/set_iteration)
+        record_active("model_inputs", "batch", batch)
+        record_active("fwd_act", "loss", np.asarray(loss))
         self._last_grad_norm = float(metrics["grad_norm"])
         self.global_steps += 1
         self._maybe_apply_compression()

@@ -1,0 +1,134 @@
+"""Sparse (indexed-slices) gradients for embedding tables.
+
+Reference: ``deepspeed/runtime/sparse_tensor.py:13`` (``SparseTensor``,
+a COO rows+values compression of embedding grads) and the sparse
+allreduce path ``deepspeed/runtime/engine.py:2535-2608``
+(``sparse_allreduce_no_retain``: all_gather indices+values across the DP
+group instead of allreducing the dense ``[V, E]`` gradient).
+
+TPU-native formulation.  Dynamic ``nonzero()`` row extraction is a
+non-starter under XLA (shapes must be static), but the batch's token ids
+ARE the touched rows — statically shaped ``[B*S]``.  So:
+
+* :class:`SparseTensor` — (indices, values, dense_shape) pytree with the
+  reference's ``to_dense`` / ``add`` / ``sparse_size`` surface, built
+  from a batch cotangent rather than ``nonzero()``.
+* :func:`embedding_lookup` — a ``custom_vjp`` table lookup whose
+  backward replicates the SMALL ``[B*S, E]`` output cotangent across the
+  data axes (an all-gather of ``B*S*E`` elements) and segment-sums into
+  the dense grad locally.  The dense ``[V, E]`` gradient is thus born
+  replicated: XLA inserts **no vocab-sized psum** — the wire cost is the
+  reference's sparse allreduce, the arithmetic is one segment_sum.
+
+With vocab 32k, E=4096, B*S=4096/rank the gradient allreduce drops from
+``V*E = 128M`` to ``B*S*E = 16M`` elements per rank pair, same 8x-class
+saving the reference's sparse path targets.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseTensor:
+    """Indexed-slices gradient (reference ``SparseTensor``)."""
+
+    def __init__(self, indices: jax.Array, values: jax.Array,
+                 dense_shape: Tuple[int, ...]):
+        self.indices = indices          # [n] int32 row ids (may repeat)
+        self.values = values            # [n, E]
+        self.dense_shape = tuple(int(d) for d in dense_shape)
+
+    # -- pytree ------------------------------------------------------------
+    def tree_flatten(self):
+        return (self.indices, self.values), self.dense_shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    # -- reference API -------------------------------------------------------
+    @classmethod
+    def from_dense(cls, dense: jax.Array, indices: jax.Array) -> "SparseTensor":
+        """Compress ``dense`` knowing ``indices`` are the touched rows
+        (the static-shape stand-in for the reference's ``nonzero()``)."""
+        return cls(indices, dense[indices], dense.shape)
+
+    def to_dense(self) -> jax.Array:
+        """Scatter-add values back to the dense shape (duplicate indices
+        accumulate, matching ``scatter_add_`` in the reference)."""
+        return jax.ops.segment_sum(self.values, self.indices,
+                                   num_segments=self.dense_shape[0])
+
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        assert self.dense_shape == other.dense_shape
+        return SparseTensor(
+            jnp.concatenate([self.indices, other.indices]),
+            jnp.concatenate([self.values, other.values]),
+            self.dense_shape)
+
+    def sparse_size(self) -> Tuple[int, int]:
+        index_size = self.indices.shape[0]
+        value_size = self.values.shape[0] * self.values.shape[1]
+        dense_size = self.dense_shape[0] * self.dense_shape[1]
+        return index_size + value_size, dense_size
+
+    def __repr__(self):
+        return (f"SparseTensor(n={self.indices.shape[0]}, "
+                f"dense_shape={self.dense_shape})")
+
+
+def sparse_allreduce(st: SparseTensor, axis_name: str) -> SparseTensor:
+    """All-gather (indices, values) along a mesh axis (shard_map context)
+    — the wire-level operation of reference ``sparse_allreduce``
+    (engine.py:2550).  Static shapes make the reference's size-exchange /
+    padding dance unnecessary."""
+    from jax import lax
+    idx = lax.all_gather(st.indices, axis_name, axis=0, tiled=True)
+    vals = lax.all_gather(st.values, axis_name, axis=0, tiled=True)
+    return SparseTensor(idx, vals, st.dense_shape)
+
+
+def embedding_lookup(table: jax.Array, ids: jax.Array,
+                     replicate_cotangent: bool = True) -> jax.Array:
+    """``table[ids]`` whose backward is the sparse-gradient path."""
+    return _embedding_lookup(table, ids, table.shape[0],
+                             jnp.dtype(table.dtype).name,
+                             replicate_cotangent)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _embedding_lookup(table, ids, vocab, dtype_name, replicate_cotangent):
+    return table[ids]
+
+
+def _embed_fwd(table, ids, vocab, dtype_name, replicate_cotangent):
+    return table[ids], ids
+
+
+def _embed_bwd(vocab, dtype_name, replicate_cotangent, ids, ct):
+    emb = ct.shape[-1]
+    ct2 = ct.reshape(-1, emb)
+    ids2 = ids.reshape(-1)
+    if replicate_cotangent:
+        # Replicate the [B*S, E] cotangent + ids instead of the [V, E]
+        # grad: XLA all-gathers B*S*E elements over the batch axes and the
+        # dense grad below is then born replicated — no vocab-sized psum.
+        # No-op outside a mesh context (single device).
+        try:
+            ct2 = jax.lax.with_sharding_constraint(ct2, P())
+            ids2 = jax.lax.with_sharding_constraint(ids2, P())
+        except (ValueError, RuntimeError):
+            pass
+    dense = jax.ops.segment_sum(ct2.astype(jnp.float32), ids2,
+                                num_segments=vocab)
+    return dense.astype(dtype_name), None
+
+
+_embedding_lookup.defvjp(_embed_fwd, _embed_bwd)

@@ -1,0 +1,1 @@
+from .tensor_logger import TensorLogger, tap, diff_logs, record_active  # noqa: F401

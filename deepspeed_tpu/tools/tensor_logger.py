@@ -1,0 +1,186 @@
+"""Per-step named-tensor capture for cross-run diffing.
+
+Reference: ``deepspeed/tools/tensor_logger/tensor_logger.py:16``
+(``TensorLogger`` — nn.Module forward/backward hooks recording
+activations / gradients / model inputs per iteration, saved to a pickle
+for comparing two runs).
+
+TPU-native formulation: there are no module hooks in a functional jitted
+program, so capture points are explicit **taps**:
+
+* :func:`tap` — ``x = tap("name", x)`` anywhere inside (or outside)
+  jitted code.  Forward records the value under ``fwd_act``; the
+  backward pass of the same tap records the cotangent under
+  ``bwd_grad`` — the same two streams the reference's hooks capture.
+  Host transfer happens via ``jax.debug.callback``, so the tap is a
+  no-op in compiled code while no logger is active (the callback body
+  checks the active-logger stack).
+* :class:`TensorLogger` — iteration windowing (``start_iteration`` /
+  ``end_iteration``), ``log_iteration`` context manager, ``save`` to
+  ``.npz`` with flat ``it{N}/{stream}/{name}/{i}`` keys.
+* :func:`diff_logs` — compare two saved runs, returning per-key max
+  abs/rel differences (the cross-run debugging workflow the reference
+  tool exists for).
+
+Usage::
+
+    tl = TensorLogger(start_iteration=1, end_iteration=2)
+    for it, batch in enumerate(loader):
+        with tl.log_iteration(it):
+            loss = engine.train_batch(batch)   # fwd/bwd taps record
+    tl.save("run_a.npz")
+    ...
+    print(diff_logs("run_a.npz", "run_b.npz"))
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+# stack of active loggers — taps record into every active logger whose
+# iteration window admits the current iteration
+_ACTIVE: List["TensorLogger"] = []
+
+
+def record_active(stream: str, name: str, value) -> None:
+    """Record into every active logger whose window admits the current
+    iteration — the hook point for engines and taps alike."""
+    for tl in _ACTIVE:
+        tl._maybe_record(stream, name, value)
+
+
+_record = record_active  # internal alias used by the tap callbacks
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def tap(name: str, x: jax.Array) -> jax.Array:
+    """Identity whose forward records ``fwd_act/name`` and whose backward
+    records ``bwd_grad/name`` into the active :class:`TensorLogger`."""
+    jax.debug.callback(lambda v: _record("fwd_act", name, np.asarray(v)), x)
+    return x
+
+
+def _tap_fwd(name, x):
+    jax.debug.callback(lambda v: _record("fwd_act", name, np.asarray(v)), x)
+    return x, None
+
+
+def _tap_bwd(name, _res, ct):
+    jax.debug.callback(lambda v: _record("bwd_grad", name, np.asarray(v)), ct)
+    return (ct,)
+
+
+tap.defvjp(_tap_fwd, _tap_bwd)
+
+
+class TensorLogger:
+    """Iteration-windowed tensor recorder (reference ``TensorLogger``).
+
+    ``end_iteration=0`` disables recording (reference semantics);
+    iteration numbers follow the caller's counter.
+    """
+
+    def __init__(self, start_iteration: int = 0, end_iteration: int = 0,
+                 prefix: Optional[str] = None):
+        self.start_iteration = start_iteration
+        self.end_iteration = end_iteration
+        self.prefix = prefix or "model"
+        self.current_iteration = 0
+        # data[iteration][stream][name] -> list of arrays (grad-accum
+        # steps append; reference keeps lists for the same reason)
+        self.data: Dict[int, Dict[str, Dict[str, List[np.ndarray]]]] = \
+            collections.defaultdict(
+                lambda: collections.defaultdict(
+                    lambda: collections.defaultdict(list)))
+
+    # -- iteration control -------------------------------------------------
+    def set_iteration(self, iteration: int) -> None:
+        self.current_iteration = iteration
+
+    def get_num_recorded_iterations(self) -> int:
+        return len(self.data)
+
+    def _window_admits(self) -> bool:
+        if self.end_iteration == 0:
+            return False
+        return (self.start_iteration <= self.current_iteration
+                <= self.end_iteration)
+
+    @contextlib.contextmanager
+    def log_iteration(self, iteration: int):
+        self.current_iteration = iteration
+        _ACTIVE.append(self)
+        try:
+            yield self
+        finally:
+            _ACTIVE.remove(self)
+
+    def __enter__(self):
+        _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _ACTIVE.remove(self)
+        return False
+
+    # -- recording ---------------------------------------------------------
+    def _maybe_record(self, stream: str, name: str, value: np.ndarray):
+        if self._window_admits():
+            self.record(stream, name, value)
+
+    def record(self, stream: str, name: str, value) -> None:
+        """Direct host-side record (engine uses this for model inputs and
+        loss — the reference overloads ``model.forward`` for inputs)."""
+        leaves, _ = jax.tree.flatten(value)
+        for i, leaf in enumerate(leaves):
+            key = name if len(leaves) == 1 else f"{name}.{i}"
+            self.data[self.current_iteration][stream][key].append(
+                np.asarray(leaf))
+
+    def clear(self) -> None:
+        self.data.clear()
+
+    # -- persistence -------------------------------------------------------
+    def save(self, filename: str, do_clear: bool = True) -> None:
+        flat = {}
+        for it, streams in self.data.items():
+            for stream, names in streams.items():
+                for name, tensors in names.items():
+                    for i, t in enumerate(tensors):
+                        flat[f"it{it}/{stream}/{self.prefix}.{name}/{i}"] = t
+        np.savez_compressed(filename, **flat)
+        if do_clear:
+            self.clear()
+
+
+def diff_logs(file_a: str, file_b: str, rtol: float = 1e-5,
+              atol: float = 1e-6) -> List[Tuple[str, float, float]]:
+    """Compare two saved runs.  Returns ``(key, max_abs, max_rel)`` for
+    every key that differs beyond tolerance, plus entries with
+    ``max_abs = inf`` for keys present in only one run."""
+    a = np.load(file_a)
+    b = np.load(file_b)
+    out: List[Tuple[str, float, float]] = []
+    keys_a, keys_b = set(a.files), set(b.files)
+    for k in sorted(keys_a ^ keys_b):
+        out.append((k, float("inf"), float("inf")))
+    for k in sorted(keys_a & keys_b):
+        ta, tb = a[k], b[k]
+        if ta.shape != tb.shape:
+            out.append((k, float("inf"), float("inf")))
+            continue
+        ta32 = ta.astype(np.float64)
+        tb32 = tb.astype(np.float64)
+        absd = np.abs(ta32 - tb32)
+        max_abs = float(absd.max()) if absd.size else 0.0
+        denom = np.maximum(np.abs(tb32), 1e-12)
+        max_rel = float((absd / denom).max()) if absd.size else 0.0
+        if max_abs > atol and max_rel > rtol:
+            out.append((k, max_abs, max_rel))
+    return out

@@ -115,6 +115,43 @@ class TestPagedAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_pallas_decode_kernel_matches_jnp(self):
+        """Q=1 Pallas decode (interpret mode on CPU) == jnp gather path."""
+        (q, k_new, v_new, kv, table, start, q_lens,
+         _, _, _) = self._setup(Q=1, D=128, hist=(5, 0, 11))
+        kv = pa.write_kv(kv, k_new, v_new, table, start, q_lens)
+        ref = pa.paged_attention(q, kv, table, start, q_lens,
+                                 interpret=False)  # jnp path off-TPU
+        out = pa.paged_decode_attention(q, kv, table, start, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_pallas_decode_kernel_gqa_groups(self):
+        (q, k_new, v_new, kv, table, start, q_lens,
+         _, _, _) = self._setup(S=4, Q=1, K=2, G=4, D=128,
+                                hist=(0, 7, 16, 40))
+        kv = pa.write_kv(kv, k_new, v_new, table, start, q_lens)
+        ref = pa.paged_attention(q, kv, table, start, q_lens,
+                                 interpret=False)
+        out = pa.paged_decode_attention(q, kv, table, start, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rope_write_kv_matches_separate(self):
+        from deepspeed_tpu.models.transformer import apply_rope, rope_table
+        from deepspeed_tpu.models.llama import llama_config
+        (q, k_new, v_new, kv, table, start, q_lens,
+         _, _, _) = self._setup()
+        cfg = llama_config("debug", head_dim=16)
+        pos = pa.token_positions(start, k_new.shape[1])
+        sin, cos = rope_table(cfg, pos)
+        fused = pa.rope_write_kv(kv, k_new, v_new, sin, cos, table, start,
+                                 q_lens)
+        manual = pa.write_kv(kv, apply_rope(k_new, sin, cos), v_new, table,
+                             start, q_lens)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(manual),
+                                   rtol=1e-6, atol=1e-6)
+
     def test_padding_slot_writes_go_to_null_page(self):
         q, k_new, v_new, kv, table, start, q_lens = self._setup()[:7]
         q_lens = q_lens.at[1].set(0)  # slot 1 becomes padding

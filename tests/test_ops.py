@@ -174,3 +174,101 @@ class TestQuantization:
             for r in range(P_)])
         ref = deq.sum(axis=0)  # [block r, 512] summed over source ranks
         np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# FP quantizer (fp8 / fp6 / fp4)
+# ---------------------------------------------------------------------------
+
+class TestFPQuantizer:
+    """ops/fp_quantizer — reference csrc/fp_quantizer + ops/fp_quantizer/
+    quantize.py FP_Quantize parity surface."""
+
+    @pytest.mark.parametrize("fmt,rel", [
+        ("fp8_e4m3", 2 ** -3), ("fp8_e5m2", 2 ** -2),
+        ("fp6_e3m2", 2 ** -2), ("fp6_e2m3", 2 ** -3),
+        ("fp4_e2m1", 2 ** -1)])
+    def test_roundtrip_error_bounded(self, fmt, rel):
+        from deepspeed_tpu.ops import fp_quantizer as fq
+        x = rand(4096, seed=3)
+        y = fq.quantize_dequantize(x, group_size=512, fmt=fmt)
+        # relative error per element bounded by half an ulp at that
+        # element's magnitude scale (loose: subnormal region is coarser)
+        err = np.abs(np.asarray(x, np.float32) - np.asarray(y, np.float32))
+        bound = np.maximum(np.abs(np.asarray(x)) * rel,
+                           np.abs(np.asarray(x)).max() * rel / 4)
+        assert (err <= bound + 1e-7).mean() > 0.99
+
+    def test_fp8_storage_dtype_and_shapes(self):
+        from deepspeed_tpu.ops import fp_quantizer as fq
+        x = rand(1000, seed=4)
+        q, s, pad = fq.quantize(x, group_size=512, fmt="fp8_e4m3")
+        assert q.dtype == jnp.float8_e4m3fn
+        assert q.shape == (2, 512) and s.shape == (2,) and pad == 24
+        y = fq.dequantize(q, s, pad, x.shape, jnp.float32)
+        assert y.shape == x.shape
+
+    def test_q_bits_api_matches_reference_keys(self):
+        from deepspeed_tpu.ops import fp_quantizer as fq
+        x = rand(512, seed=5)
+        for bits in (4, 6, 8, 12):
+            q, s, pad = fq.quantize(x, q_bits=bits)
+            assert q.shape[0] == 1
+
+    def test_fp6_values_live_on_fp6_grid(self):
+        from deepspeed_tpu.ops import fp_quantizer as fq
+        x = rand(512, seed=6)
+        q, s, pad = fq.quantize(x, group_size=512, fmt="fp6_e3m2")
+        grid = fq._fp6_grid_cached("fp6_e3m2")
+        vals = np.abs(np.asarray(q, np.float32)).ravel()
+        dist = np.min(np.abs(vals[:, None] - grid[None, :]), axis=1)
+        assert dist.max() == 0.0
+
+    def test_selective_dequantize(self):
+        from deepspeed_tpu.ops import fp_quantizer as fq
+        x = rand(2048, seed=7)
+        q, s, pad = fq.quantize(x, group_size=512, fmt="fp8_e4m3")
+        rows = jnp.asarray([1, 3])
+        part = fq.selective_dequantize(q, s, rows, jnp.float32)
+        full = fq.dequantize(q, s, pad, (2048,), jnp.float32).reshape(4, 512)
+        np.testing.assert_allclose(np.asarray(part),
+                                   np.asarray(full[np.asarray(rows)]),
+                                   rtol=1e-6)
+
+    def test_straight_through_grad(self):
+        from deepspeed_tpu.ops import fp_quantizer as fq
+        x = rand(512, seed=8)
+        g = jax.grad(lambda v: fq.quantize_dequantize_st(v, 512,
+                                                         "fp8_e4m3").sum())(x)
+        np.testing.assert_allclose(np.asarray(g), np.ones_like(g), rtol=1e-6)
+
+    def test_optimized_linear_fp8_base(self):
+        from deepspeed_tpu.linear import (LoRAConfig, OptimizedLinear,
+                                          QuantizationConfig)
+        lin = OptimizedLinear(
+            256, 128, lora_config=LoRAConfig(lora_r=8),
+            quantization_config=QuantizationConfig(q_dtype="fp8_e4m3",
+                                                   group_size=512))
+        params = lin.init(jax.random.key(0))
+        assert params["base_q"].dtype == jnp.float8_e4m3fn
+        x = rand(4, 256, seed=9)
+        y = lin.apply(params, x)
+        assert y.shape == (4, 128)
+        # fp8 base ~= dense base within fp8 relative error
+        w = lin.merge(params)
+        ref = np.asarray(x, np.float32) @ np.asarray(w, np.float32)
+        np.testing.assert_allclose(np.asarray(y, np.float32), ref,
+                                   atol=0.35, rtol=0.3)
+
+    def test_fp_quantize_object_api_roundtrip(self):
+        from deepspeed_tpu.ops.fp_quantizer import FP_Quantize
+        fq = FP_Quantize(group_size=512)
+        x = rand(1000, seed=10)
+        qt = fq.quantize(x)  # default: self-describing QuantizedTensor
+        y = fq.dequantize(qt)
+        assert y.shape == x.shape
+        err = np.abs(np.asarray(x) - np.asarray(y, np.float32))
+        assert err.max() <= np.abs(np.asarray(x)).max() * 2 ** -3 + 1e-6
+        q, s = fq.quantize(x, return_meta_tensor=True)
+        with pytest.raises(ValueError):
+            fq.dequantize(q)  # raw buffer without scale must fail loudly

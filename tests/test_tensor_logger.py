@@ -1,0 +1,96 @@
+"""tools/tensor_logger — reference deepspeed/tools/tensor_logger parity."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+from deepspeed_tpu.tools import TensorLogger, diff_logs, tap
+
+
+class TestTap:
+    def test_fwd_and_bwd_streams(self):
+        tl = TensorLogger(start_iteration=0, end_iteration=5)
+
+        def f(x):
+            h = tap("hidden", x * 2.0)
+            return jnp.sum(h ** 2)
+
+        x = jnp.arange(4.0)
+        with tl.log_iteration(0):
+            g = jax.grad(f)(x)
+            jax.block_until_ready(g)
+        assert tl.get_num_recorded_iterations() == 1
+        rec = tl.data[0]
+        np.testing.assert_allclose(rec["fwd_act"]["hidden"][0],
+                                   np.asarray(x) * 2.0)
+        # d/dh sum(h^2) = 2h = 4x
+        np.testing.assert_allclose(rec["bwd_grad"]["hidden"][0],
+                                   4.0 * np.asarray(x))
+
+    def test_window_excludes_iterations(self):
+        tl = TensorLogger(start_iteration=2, end_iteration=3)
+        for it in range(5):
+            with tl.log_iteration(it):
+                jax.block_until_ready(tap("x", jnp.ones(2)))
+        assert sorted(tl.data.keys()) == [2, 3]
+
+    def test_disabled_by_default_end_zero(self):
+        tl = TensorLogger()
+        with tl.log_iteration(0):
+            jax.block_until_ready(tap("x", jnp.ones(2)))
+        assert tl.get_num_recorded_iterations() == 0
+
+    def test_noop_without_active_logger(self):
+        out = jax.jit(lambda x: tap("y", x) + 1)(jnp.ones(3))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+class TestSaveDiff:
+    def test_save_and_diff_roundtrip(self, tmp_path):
+        def run(scale):
+            tl = TensorLogger(start_iteration=0, end_iteration=1)
+            with tl.log_iteration(0):
+                jax.block_until_ready(tap("h", jnp.arange(8.0) * scale))
+            f = os.path.join(tmp_path, f"run_{scale}.npz")
+            tl.save(f)
+            return f
+
+        a, b = run(1.0), run(1.0)
+        assert diff_logs(a, b) == []
+        c = run(2.0)
+        diffs = diff_logs(a, c)
+        assert len(diffs) == 1 and diffs[0][0].startswith("it0/fwd_act")
+
+    def test_diff_reports_missing_keys(self, tmp_path):
+        tl = TensorLogger(start_iteration=0, end_iteration=1)
+        with tl.log_iteration(0):
+            jax.block_until_ready(tap("only_in_a", jnp.ones(2)))
+        fa = os.path.join(tmp_path, "a.npz")
+        tl.save(fa)
+        tl2 = TensorLogger(start_iteration=0, end_iteration=1)
+        fb = os.path.join(tmp_path, "b.npz")
+        tl2.save(fb)
+        diffs = diff_logs(fa, fb)
+        assert len(diffs) == 1 and diffs[0][1] == float("inf")
+
+
+class TestEngineIntegration:
+    def test_engine_records_inputs_and_loss(self):
+        model = LlamaForCausalLM("debug")
+        engine, _, _, _ = dst.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000})
+        tl = TensorLogger(start_iteration=0, end_iteration=10)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, 128, size=(engine.train_batch_size(), 16)).astype(np.int32)}
+        with tl.log_iteration(0):
+            engine.train_batch(batch)
+        rec = tl.data[0]
+        assert "loss" in rec["fwd_act"]
+        assert any(k.startswith("batch") for k in rec["model_inputs"])
