@@ -34,13 +34,26 @@ def _np(t) -> np.ndarray:
         return np.asarray(t)
 
 
-def _unpermute_rope(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
-    """Invert the HF conversion permute: [H*D, E] rows from half-split
-    order back to interleaved order."""
+def _unpermute_rope(w: np.ndarray, n_heads: int, head_dim: int,
+                    rot_dim: int = None) -> np.ndarray:
+    """Convert [H*D, E] projection rows (or [H*D] bias with E absent)
+    from half-split ("rotate_half") lane order to interleaved-pair order.
+
+    Used both to invert the HF llama conversion permute and to express
+    natively-half-split models (GPT-NeoX) in the interleaved core; with
+    ``rot_dim < head_dim`` (partial rotary) only the leading rotary lanes
+    of each head are reordered."""
+    squeeze = w.ndim == 1
+    if squeeze:
+        w = w[:, None]
     E = w.shape[1]
-    w = w.reshape(n_heads, 2, head_dim // 2, E)
-    w = np.transpose(w, (0, 2, 1, 3))  # (H, D/2, 2, E)
-    return w.reshape(n_heads * head_dim, E)
+    rot = head_dim if rot_dim is None else rot_dim
+    w = w.reshape(n_heads, head_dim, E)
+    head = w[:, :rot].reshape(n_heads, 2, rot // 2, E)
+    head = np.transpose(head, (0, 2, 1, 3)).reshape(n_heads, rot, E)
+    w = np.concatenate([head, w[:, rot:]], axis=1)
+    w = w.reshape(n_heads * head_dim, E)
+    return w[:, 0] if squeeze else w
 
 
 def llama_config_from_hf(hf_cfg) -> TransformerConfig:
@@ -62,8 +75,9 @@ def llama_config_from_hf(hf_cfg) -> TransformerConfig:
 
 
 def load_llama(state_dict: Dict[str, Any], cfg: TransformerConfig,
-               dtype=jnp.float32) -> Dict[str, Any]:
-    """HF LLaMA/Mistral state dict -> our (unboxed) param tree."""
+               dtype=jnp.float32, skip_mlp: bool = False) -> Dict[str, Any]:
+    """HF LLaMA/Mistral state dict -> our (unboxed) param tree.
+    ``skip_mlp``: leave the mlp block out (mixtral fills it with MoE)."""
     sd = {k: _np(v) for k, v in state_dict.items()}
     E = cfg.hidden_size
     H, K, D = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
@@ -82,21 +96,23 @@ def load_llama(state_dict: Dict[str, Any], cfg: TransformerConfig,
         wk = _unpermute_rope(key(p + "self_attn.k_proj.weight"), K, D)
         wv = key(p + "self_attn.v_proj.weight")
         wo = key(p + "self_attn.o_proj.weight")
-        layers.append({
+        layer = {
             "attn": {
                 "wq": wq.T.reshape(E, H, D),
                 "wk": wk.T.reshape(E, K, D),
                 "wv": wv.T.reshape(E, K, D),
                 "wo": wo.T.reshape(H, D, E),
             },
-            "mlp": {
+            "norm1": {"scale": key(p + "input_layernorm.weight")},
+            "norm2": {"scale": key(p + "post_attention_layernorm.weight")},
+        }
+        if not skip_mlp:
+            layer["mlp"] = {
                 "wg": key(p + "mlp.gate_proj.weight").T,
                 "wi": key(p + "mlp.up_proj.weight").T,
                 "wo": key(p + "mlp.down_proj.weight").T,
-            },
-            "norm1": {"scale": key(p + "input_layernorm.weight")},
-            "norm2": {"scale": key(p + "post_attention_layernorm.weight")},
-        })
+            }
+        layers.append(layer)
 
     params: Dict[str, Any] = {
         "embed": {"tokens": key("model.embed_tokens.weight")},
@@ -107,6 +123,164 @@ def load_llama(state_dict: Dict[str, Any], cfg: TransformerConfig,
     if not cfg.tie_embeddings:
         params["lm_head"] = key("lm_head.weight").T
     return _cast(params, dtype)
+
+
+def qwen2_config_from_hf(hf_cfg) -> TransformerConfig:
+    """Qwen2/Qwen2.5: llama-family geometry + attention-only qkv biases
+    (reference v2 impl ``model_implementations/qwen_v2/model.py``)."""
+    cfg = llama_config_from_hf(hf_cfg)
+    import dataclasses
+    return dataclasses.replace(cfg, qkv_bias=True)
+
+
+def load_qwen2(state_dict: Dict[str, Any], cfg: TransformerConfig,
+               dtype=jnp.float32) -> Dict[str, Any]:
+    """HF Qwen2 state dict -> param tree: llama layout + q/k/v biases
+    (bias rows need the same rope unpermute as the weight rows)."""
+    params = load_llama(state_dict, cfg, dtype)
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    H, K, D = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+    biases = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}.self_attn."
+        biases.append({
+            "bq": _unpermute_rope(sd[p + "q_proj.bias"], H, D).reshape(H, D),
+            "bk": _unpermute_rope(sd[p + "k_proj.bias"], K, D).reshape(K, D),
+            "bv": sd[p + "v_proj.bias"].reshape(K, D),
+        })
+    _merge_layer_params(params, cfg, "attn", biases, dtype)
+    return params
+
+
+def mixtral_config_from_hf(hf_cfg) -> TransformerConfig:
+    return llama_config_from_hf(hf_cfg)
+
+
+def load_mixtral(state_dict: Dict[str, Any], cfg: TransformerConfig,
+                 dtype=jnp.float32) -> Dict[str, Any]:
+    """HF Mixtral state dict -> param tree with stacked-expert MoE mlp
+    (reference ``model_implementations/mixtral/model.py``; expert
+    weights transposed into the [E, in, out] layout moe/layer.py's
+    grouped einsum consumes)."""
+    params = load_llama(state_dict, cfg, dtype, skip_mlp=True)
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    n_experts = 0
+    while f"model.layers.0.block_sparse_moe.experts.{n_experts}.w1.weight" \
+            in sd:
+        n_experts += 1
+    if n_experts == 0:
+        raise KeyError("no block_sparse_moe experts in checkpoint")
+    moe_layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}.block_sparse_moe."
+        # HF: w1 = gate proj [F, E], w3 = up proj [F, E], w2 = down [E, F]
+        moe_layers.append({
+            "gate": sd[p + "gate.weight"].T,                     # [E, experts]
+            "wg": np.stack([sd[p + f"experts.{e}.w1.weight"].T
+                            for e in range(n_experts)]),
+            "wi": np.stack([sd[p + f"experts.{e}.w3.weight"].T
+                            for e in range(n_experts)]),
+            "wo": np.stack([sd[p + f"experts.{e}.w2.weight"].T
+                            for e in range(n_experts)]),
+        })
+    _replace_layer_params(params, cfg, "mlp", moe_layers, dtype)
+    return params
+
+
+def gpt_neox_config_from_hf(hf_cfg) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=hf_cfg.num_attention_heads,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_eps,
+        activation="gelu", pos_emb="rope",
+        rope_theta=getattr(hf_cfg, "rotary_emb_base", 10000.0),
+        rope_pct=getattr(hf_cfg, "rotary_pct", 1.0),
+        parallel_residual=getattr(hf_cfg, "use_parallel_residual", True),
+        tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        use_bias=True, dtype=jnp.bfloat16)
+
+
+def load_gpt_neox(state_dict: Dict[str, Any], cfg: TransformerConfig,
+                  dtype=jnp.float32) -> Dict[str, Any]:
+    """HF GPT-NeoX state dict -> param tree.
+
+    query_key_value packs [H, 3, D] along the output dim; NeoX rotates
+    half-split natively, so q/k rows are re-laned to interleaved (only
+    the ``rotary_pct`` leading lanes rotate)."""
+    sd = {k.removeprefix("gpt_neox."): _np(v)
+          for k, v in state_dict.items()}
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.dims_per_head
+    rot = int(D * cfg.rope_pct) - int(D * cfg.rope_pct) % 2
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}."
+        w_qkv = sd[p + "attention.query_key_value.weight"]   # [H*3*D, E]
+        b_qkv = sd[p + "attention.query_key_value.bias"]     # [H*3*D]
+        w = w_qkv.reshape(H, 3, D, E)
+        b = b_qkv.reshape(H, 3, D)
+        wq = _unpermute_rope(w[:, 0].reshape(H * D, E), H, D, rot)
+        wk = _unpermute_rope(w[:, 1].reshape(H * D, E), H, D, rot)
+        wv = w[:, 2].reshape(H * D, E)
+        bq = _unpermute_rope(b[:, 0].reshape(H * D), H, D, rot)
+        bk = _unpermute_rope(b[:, 1].reshape(H * D), H, D, rot)
+        layers.append({
+            "attn": {
+                "wq": wq.T.reshape(E, H, D),
+                "wk": wk.T.reshape(E, H, D),
+                "wv": wv.T.reshape(E, H, D),
+                "wo": sd[p + "attention.dense.weight"].T.reshape(H, D, E),
+                "bq": bq.reshape(H, D), "bk": bk.reshape(H, D),
+                "bv": b[:, 2].reshape(H, D),
+                "bo": sd[p + "attention.dense.bias"],
+            },
+            "mlp": {
+                "wi": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                "bi": sd[p + "mlp.dense_h_to_4h.bias"],
+                "wo": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                "bo": sd[p + "mlp.dense_4h_to_h.bias"],
+            },
+            "norm1": {"scale": sd[p + "input_layernorm.weight"],
+                      "bias": sd[p + "input_layernorm.bias"]},
+            "norm2": {"scale": sd[p + "post_attention_layernorm.weight"],
+                      "bias": sd[p + "post_attention_layernorm.bias"]},
+        })
+    params = {
+        "embed": {"tokens": sd["embed_in.weight"]},
+        "layers": _stack(layers) if cfg.scan_layers
+        else {f"layer_{i}": l for i, l in enumerate(layers)},
+        "final_norm": {"scale": sd["final_layer_norm.weight"],
+                       "bias": sd["final_layer_norm.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["embed_out.weight"].T
+    return _cast(params, dtype)
+
+
+def _merge_layer_params(params, cfg, block, per_layer, dtype):
+    """Add new leaves into each layer's ``block`` dict (scan-stacked or
+    per-layer)."""
+    if cfg.scan_layers:
+        stacked = _stack(per_layer)
+        for k2, v in stacked.items():
+            params["layers"][block][k2] = jnp.asarray(v, dtype)
+    else:
+        for i, extra in enumerate(per_layer):
+            for k2, v in extra.items():
+                params["layers"][f"layer_{i}"][block][k2] = \
+                    jnp.asarray(v, dtype)
+
+
+def _replace_layer_params(params, cfg, block, per_layer, dtype):
+    if cfg.scan_layers:
+        params["layers"][block] = _cast(_stack(per_layer), dtype)
+    else:
+        for i, newp in enumerate(per_layer):
+            params["layers"][f"layer_{i}"][block] = _cast(newp, dtype)
 
 
 def gpt2_config_from_hf(hf_cfg) -> TransformerConfig:
@@ -168,20 +342,34 @@ def load_gpt2(state_dict: Dict[str, Any], cfg: TransformerConfig,
     return _cast(params, dtype)
 
 
+def load_hf_model(model_or_path):
+    """Normalize a path-or-instance to a transformers model instance —
+    the single place checkpoint-loading policy lives."""
+    if isinstance(model_or_path, str):
+        import transformers
+        return transformers.AutoModelForCausalLM.from_pretrained(
+            model_or_path, local_files_only=True)
+    return model_or_path
+
+
 def from_pretrained(model_or_path, dtype=jnp.float32
                     ) -> Tuple[TransformerConfig, Dict[str, Any]]:
     """Convert a transformers model instance or local checkpoint dir."""
-    if isinstance(model_or_path, str):
-        import transformers
-        model = transformers.AutoModelForCausalLM.from_pretrained(
-            model_or_path, local_files_only=True)
-    else:
-        model = model_or_path
+    model = load_hf_model(model_or_path)
     arch = model.config.model_type
     sd = model.state_dict()
     if arch in ("llama", "mistral"):
         cfg = llama_config_from_hf(model.config)
         return cfg, load_llama(sd, cfg, dtype)
+    if arch == "qwen2":
+        cfg = qwen2_config_from_hf(model.config)
+        return cfg, load_qwen2(sd, cfg, dtype)
+    if arch == "mixtral":
+        cfg = mixtral_config_from_hf(model.config)
+        return cfg, load_mixtral(sd, cfg, dtype)
+    if arch == "gpt_neox":
+        cfg = gpt_neox_config_from_hf(model.config)
+        return cfg, load_gpt_neox(sd, cfg, dtype)
     if arch == "gpt2":
         cfg = gpt2_config_from_hf(model.config)
         return cfg, load_gpt2(sd, cfg, dtype)

@@ -34,10 +34,20 @@ class RaggedInferenceModel:
     def __init__(self, cfg: T.TransformerConfig, params: Any,
                  kv_config: Optional[KVCacheConfig] = None,
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 mlp_fn: Optional[Callable] = None):
+                 mlp_fn: Optional[Callable] = None,
+                 attention_impl: Optional[str] = None):
         self.cfg = cfg
         self.mesh = mesh
         self.mlp_fn = mlp_fn
+        # implementation chosen through the registry/heuristics seam
+        # (reference heuristics.instantiate_attention); attention_impl
+        # pins a named implementation, None lets the heuristic pick
+        from .modules import instantiate
+        self._attention = instantiate("ragged_attention", cfg,
+                                      name=attention_impl)
+        self._norm = instantiate("norm", cfg)
+        self._embed = instantiate("embedding", cfg)
+        self._unembed = instantiate("unembed", cfg)
         self.kv_config_explicit = kv_config is not None
         self.kv_config = kv_config or KVCacheConfig(
             num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
@@ -107,7 +117,8 @@ class RaggedInferenceModel:
                    page_table):
         cfg = self.cfg
         S, Q = token_ids.shape
-        x = params["embed"]["tokens"].astype(cfg.dtype)[token_ids]
+        x = self._embed(params["embed"]["tokens"].astype(cfg.dtype),
+                        token_ids)
         pos = token_positions(start_pos, Q)
         if cfg.pos_emb == "learned":
             safe = jnp.minimum(pos, cfg.max_seq_len - 1)
@@ -129,26 +140,23 @@ class RaggedInferenceModel:
                 kv_layers.append(kv_i)
             kv = jnp.stack(kv_layers)
 
-        x = T._norm_apply(cfg, params["final_norm"], x)
-        last = gather_last(x, q_lens)                       # [S, E]
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("se,ve->sv", last,
-                                params["embed"]["tokens"].astype(cfg.dtype))
-        else:
-            logits = jnp.einsum("se,ev->sv", last,
-                                params["lm_head"].astype(cfg.dtype))
+        x = self._norm(params["final_norm"], x)
+        head = (params["embed"]["tokens"].astype(cfg.dtype).T
+                if cfg.tie_embeddings
+                else params["lm_head"].astype(cfg.dtype))
+        logits = self._unembed(x, q_lens, head)             # [S, V]
         return logits.astype(jnp.float32), kv
 
     def _layer_body(self, x, lp, kv_layer, *, pos, sin, cos, q_lens,
                     start_pos, page_table):
         cfg = self.cfg
         dtype = cfg.dtype
-        h = T._norm_apply(cfg, lp["norm1"], x)
+        h = self._norm(lp["norm1"], x)
         ap = lp["attn"]
         q = jnp.einsum("sqe,ehd->sqhd", h, ap["wq"].astype(dtype))
         k = jnp.einsum("sqe,ekd->sqkd", h, ap["wk"].astype(dtype))
         v = jnp.einsum("sqe,ekd->sqkd", h, ap["wv"].astype(dtype))
-        if cfg.use_bias:
+        if cfg.use_bias or cfg.qkv_bias:
             q = q + ap["bq"].astype(dtype)
             k = k + ap["bk"].astype(dtype)
             v = v + ap["bv"].astype(dtype)
@@ -159,12 +167,18 @@ class RaggedInferenceModel:
         else:
             kv_layer = write_kv(kv_layer, k, v, page_table, start_pos,
                                 q_lens)
-        attn = paged_attention(q, kv_layer, page_table, start_pos, q_lens)
+        attn = self._attention(q, kv_layer, page_table, start_pos, q_lens)
         out = jnp.einsum("sqhd,hde->sqe", attn, ap["wo"].astype(dtype))
         if cfg.use_bias:
             out = out + ap["bo"].astype(dtype)
+        if cfg.parallel_residual:
+            h2 = self._norm(lp["norm2"], x)
+            mlp_out = (self.mlp_fn or T._mlp_block)(cfg, lp["mlp"], h2)
+            if isinstance(mlp_out, tuple):                  # MoE aux dropped
+                mlp_out = mlp_out[0]
+            return x + out.astype(x.dtype) + mlp_out.astype(x.dtype), kv_layer
         x = x + out.astype(x.dtype)
-        h = T._norm_apply(cfg, lp["norm2"], x)
+        h = self._norm(lp["norm2"], x)
         mlp_out = (self.mlp_fn or T._mlp_block)(cfg, lp["mlp"], h)
         if isinstance(mlp_out, tuple):                      # MoE aux dropped
             mlp_out = mlp_out[0]

@@ -52,7 +52,13 @@ class TransformerConfig:
     activation: str = "silu_gated"     # silu_gated | gelu | gelu_gated
     pos_emb: str = "rope"              # rope | learned | none
     rope_theta: float = 10000.0
+    rope_pct: float = 1.0              # partial rotary (GPT-NeoX/phi)
     causal: bool = True
+    # attention-only biases (Qwen2: qkv bias, no o/mlp bias); use_bias
+    # adds biases everywhere (GPT-2/NeoX style)
+    qkv_bias: bool = False
+    # x + attn(ln1 x) + mlp(ln2 x) (GPT-NeoX use_parallel_residual)
+    parallel_residual: bool = False
     tie_embeddings: bool = False
     use_bias: bool = False
     dropout: float = 0.0
@@ -133,10 +139,11 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
         }
         if "gated" in cfg.activation:
             p["mlp"]["wg"] = _boxed(_dense_init(ks[6], (e, f), e), ("embed", "mlp"))
-        if cfg.use_bias:
+        if cfg.use_bias or cfg.qkv_bias:
             p["attn"]["bq"] = _boxed(jnp.zeros((h, d)), ("heads", None))
             p["attn"]["bk"] = _boxed(jnp.zeros((k, d)), ("kv", None))
             p["attn"]["bv"] = _boxed(jnp.zeros((k, d)), ("kv", None))
+        if cfg.use_bias:
             p["attn"]["bo"] = _boxed(jnp.zeros((e,)), ("embed",))
             p["mlp"]["bi"] = _boxed(jnp.zeros((f,)), ("mlp",))
             p["mlp"]["bo"] = _boxed(jnp.zeros((e,)), ("embed",))
@@ -189,20 +196,28 @@ def _norm_apply(cfg: TransformerConfig, p, x: jax.Array) -> jax.Array:
 
 
 def rope_table(cfg: TransformerConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    d = cfg.dims_per_head
+    d = int(cfg.dims_per_head * cfg.rope_pct)
+    d -= d % 2
     freqs = cfg.rope_theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,d/2]
     return jnp.sin(angles), jnp.cos(angles)
 
 
 def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
-    """x: [B,S,H,D]; interleaved-pair rotation in fp32."""
-    x32 = x.astype(jnp.float32)
-    x1, x2 = x32[..., 0::2], x32[..., 1::2]
+    """x: [B,S,H,D]; interleaved-pair rotation in fp32.  When the rope
+    table covers fewer than D/2 frequencies (partial rotary,
+    ``rope_pct < 1``), only the leading ``2*n_freq`` dims rotate and the
+    tail passes through (GPT-NeoX ``rotary_pct`` semantics)."""
+    rot = 2 * sin.shape[-1]
+    head = x[..., :rot].astype(jnp.float32)
+    x1, x2 = head[..., 0::2], head[..., 1::2]
     sin, cos = sin[:, :, None, :], cos[:, :, None, :]
     r1 = x1 * cos - x2 * sin
     r2 = x2 * cos + x1 * sin
-    return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(x.dtype)
+    out = jnp.stack([r1, r2], axis=-1).reshape(head.shape).astype(x.dtype)
+    if rot == x.shape[-1]:
+        return out
+    return jnp.concatenate([out, x[..., rot:]], axis=-1)
 
 
 def _activation(cfg: TransformerConfig, gate, up):
@@ -320,7 +335,7 @@ def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
     q = jnp.einsum("bse,ehd->bshd", x, wq)
     k = jnp.einsum("bse,ekd->bskd", x, wk)
     v = jnp.einsum("bse,ekd->bskd", x, wv)
-    if cfg.use_bias:
+    if cfg.use_bias or cfg.qkv_bias:
         q = q + p["bq"].astype(dtype)
         k = k + p["bk"].astype(dtype)
         v = v + p["bv"].astype(dtype)
@@ -363,8 +378,17 @@ def _layer_body(cfg: TransformerConfig, layer_params, x, sin, cos, mask,
     """Returns (x, aux) — aux is 0 for dense MLPs, the load-balancing loss
     for MoE mlp_fns (accumulated through the layer scan)."""
     h = _norm_apply(cfg, layer_params["norm1"], x)
-    x = x + _attention_block(cfg, layer_params["attn"], h, sin, cos, mask,
-                             use_flash=use_flash)
+    attn_out = _attention_block(cfg, layer_params["attn"], h, sin, cos,
+                                mask, use_flash=use_flash)
+    if cfg.parallel_residual:
+        # GPT-NeoX: mlp sees ln2(x), both branches add to the SAME input
+        h2 = _norm_apply(cfg, layer_params["norm2"], x)
+        mlp_out = (mlp_fn or _mlp_block)(cfg, layer_params["mlp"], h2)
+        aux = jnp.zeros((), jnp.float32)
+        if isinstance(mlp_out, tuple):
+            mlp_out, aux = mlp_out
+        return x + attn_out + mlp_out, aux
+    x = x + attn_out
     h = _norm_apply(cfg, layer_params["norm2"], x)
     mlp_out = (mlp_fn or _mlp_block)(cfg, layer_params["mlp"], h)
     aux = jnp.zeros((), jnp.float32)

@@ -38,9 +38,9 @@ class InjectionPolicy:
 
 
 class LlamaPolicy(InjectionPolicy):
-    """Llama/Llama-2/Mistral/Qwen-family (reference containers/llama.py,
-    llama2.py; mistral/qwen share the rotary+GQA+SwiGLU shape)."""
-    MODEL_TYPES = ("llama", "mistral", "qwen2", "qwen")
+    """Llama/Llama-2/Mistral family (reference containers/llama.py,
+    llama2.py; mistral shares the rotary+GQA+SwiGLU shape)."""
+    MODEL_TYPES = ("llama", "mistral")
 
     @classmethod
     def config_from_hf(cls, hf_cfg):
@@ -49,6 +49,48 @@ class LlamaPolicy(InjectionPolicy):
     @classmethod
     def load(cls, state_dict, cfg, dtype):
         return hf_ckpt.load_llama(state_dict, cfg, dtype=dtype)
+
+
+class Qwen2Policy(InjectionPolicy):
+    """Qwen2/Qwen2.5 (reference v2 model_implementations/qwen_v2):
+    llama shape + attention qkv biases."""
+    MODEL_TYPES = ("qwen2",)
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.qwen2_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_qwen2(state_dict, cfg, dtype=dtype)
+
+
+class MixtralPolicy(InjectionPolicy):
+    """Mixtral sparse-MoE (reference v2 model_implementations/mixtral):
+    llama attention + top-k routed stacked experts."""
+    MODEL_TYPES = ("mixtral",)
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.mixtral_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_mixtral(state_dict, cfg, dtype=dtype)
+
+
+class GPTNeoXPolicy(InjectionPolicy):
+    """GPT-NeoX/Pythia (reference containers/gptneox.py): parallel
+    residual, partial rotary, fused-QKV with biases."""
+    MODEL_TYPES = ("gpt_neox", "gptneox")
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.gpt_neox_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_gpt_neox(state_dict, cfg, dtype=dtype)
 
 
 class GPT2Policy(InjectionPolicy):
@@ -65,7 +107,7 @@ class GPT2Policy(InjectionPolicy):
         return hf_ckpt.load_gpt2(state_dict, cfg, dtype=dtype)
 
 
-_POLICIES = [LlamaPolicy, GPT2Policy]
+_POLICIES = [LlamaPolicy, Qwen2Policy, MixtralPolicy, GPTNeoXPolicy, GPT2Policy]
 
 
 def replace_policy_for(model_type: str) -> InjectionPolicy:

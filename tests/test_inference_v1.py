@@ -152,3 +152,9 @@ def test_hybrid_engine_train_and_generate():
     # rollouts must reflect the UPDATED weights (cache invalidation)
     assert engine._inference_params_step == engine.global_steps
     assert engine.generate_throughput() > 0
+
+
+def test_round4_policy_breadth():
+    assert replace_policy_for("qwen2").__name__ == "Qwen2Policy"
+    assert replace_policy_for("mixtral").__name__ == "MixtralPolicy"
+    assert replace_policy_for("gpt_neox").__name__ == "GPTNeoXPolicy"

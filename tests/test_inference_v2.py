@@ -361,3 +361,54 @@ class TestSampling:
             tok = int(sample(logits, jax.random.key(seed),
                              temperature=1.0, top_p=0.9)[0])
             assert tok in (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# module registry / heuristics seam
+# ---------------------------------------------------------------------------
+
+class TestModuleRegistry:
+    def test_heuristic_picks_supported_impl(self):
+        from deepspeed_tpu.inference.v2 import modules as M
+        impl = M.instantiate("ragged_attention", None)
+        assert callable(impl)
+        # off-TPU the pallas impl's supports() gate rejects; dense wins
+        if jax.default_backend() != "tpu":
+            assert "dense_gather" in M.implementations("ragged_attention")
+
+    def test_named_selection_and_errors(self):
+        from deepspeed_tpu.inference.v2 import modules as M
+        assert callable(M.instantiate("ragged_attention", None,
+                                      name="dense_gather"))
+        with pytest.raises(KeyError):
+            M.instantiate("ragged_attention", None, name="nope")
+        with pytest.raises(KeyError):
+            M.instantiate("not_an_op_class")
+
+    def test_register_new_impl_wins_by_priority(self):
+        from deepspeed_tpu.inference.v2 import modules as M
+        try:
+            @M.register("ragged_attention", "test_custom", priority=99)
+            def _custom(cfg):
+                return lambda *a: "custom"
+            impl = M.instantiate("ragged_attention", None)
+            assert impl() == "custom"
+        finally:  # deregister to not leak into other tests
+            M._REGISTRY["ragged_attention"] = [
+                i for i in M._REGISTRY["ragged_attention"]
+                if i.name != "test_custom"]
+
+    def test_duplicate_name_rejected(self):
+        from deepspeed_tpu.inference.v2 import modules as M
+        with pytest.raises(ValueError):
+            M.register("ragged_attention", "dense_gather")(lambda c: None)
+
+    def test_model_resolves_through_registry(self):
+        from deepspeed_tpu.inference.v2.model import RaggedInferenceModel
+        from deepspeed_tpu.models.llama import llama_config
+        from flax.core import meta as fmeta
+        from deepspeed_tpu.models.transformer import init_params
+        cfg = llama_config("debug")
+        params = fmeta.unbox(init_params(cfg, jax.random.key(0)))
+        m = RaggedInferenceModel(cfg, params, attention_impl="dense_gather")
+        assert callable(m._attention)
