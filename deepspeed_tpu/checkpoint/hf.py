@@ -137,8 +137,8 @@ def load_qwen2(state_dict: Dict[str, Any], cfg: TransformerConfig,
                dtype=jnp.float32) -> Dict[str, Any]:
     """HF Qwen2 state dict -> param tree: llama layout + q/k/v biases
     (bias rows need the same rope unpermute as the weight rows)."""
-    params = load_llama(state_dict, cfg, dtype)
     sd = {k: _np(v) for k, v in state_dict.items()}
+    params = load_llama(sd, cfg, dtype)  # _np on ndarrays is a no-op
     H, K, D = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
     biases = []
     for i in range(cfg.num_layers):
@@ -153,7 +153,11 @@ def load_qwen2(state_dict: Dict[str, Any], cfg: TransformerConfig,
 
 
 def mixtral_config_from_hf(hf_cfg) -> TransformerConfig:
-    return llama_config_from_hf(hf_cfg)
+    import dataclasses
+    return dataclasses.replace(
+        llama_config_from_hf(hf_cfg),
+        moe_num_experts=hf_cfg.num_local_experts,
+        moe_top_k=hf_cfg.num_experts_per_tok)
 
 
 def load_mixtral(state_dict: Dict[str, Any], cfg: TransformerConfig,
@@ -162,8 +166,8 @@ def load_mixtral(state_dict: Dict[str, Any], cfg: TransformerConfig,
     (reference ``model_implementations/mixtral/model.py``; expert
     weights transposed into the [E, in, out] layout moe/layer.py's
     grouped einsum consumes)."""
-    params = load_llama(state_dict, cfg, dtype, skip_mlp=True)
     sd = {k: _np(v) for k, v in state_dict.items()}
+    params = load_llama(sd, cfg, dtype, skip_mlp=True)
     n_experts = 0
     while f"model.layers.0.block_sparse_moe.experts.{n_experts}.w1.weight" \
             in sd:

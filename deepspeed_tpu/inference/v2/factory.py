@@ -23,25 +23,10 @@ def build_hf_engine(model_or_path: Any,
                     mesh: Optional[jax.sharding.Mesh] = None,
                     dtype=None) -> InferenceEngineV2:
     """Build a ragged inference engine from a transformers model instance
-    or a local HF checkpoint directory.  MoE architectures (mixtral) get
-    the stacked-expert mlp_fn wired in (reference resolves an arch policy
-    here, engine_factory.py:92)."""
-    from ...checkpoint.hf import load_hf_model
-    model_or_path = load_hf_model(model_or_path)
-    hf_cfg = model_or_path.config
+    or a local HF checkpoint directory.  MoE architectures (mixtral)
+    carry their geometry on the TransformerConfig and the model
+    self-wires the routed mlp (reference resolves an arch policy here,
+    engine_factory.py:92)."""
     cfg, params = from_pretrained(model_or_path, dtype=dtype or jnp.bfloat16)
-    mlp_fn = None
-    if hf_cfg.model_type == "mixtral":
-        from ...moe.layer import MoEConfig, moe_forward
-        # drop_tokens=False: inference must not zero out overflow tokens
-        # (HF applies no capacity limit; dropping diverges generations)
-        moe_cfg = MoEConfig(
-            num_experts=hf_cfg.num_local_experts,
-            top_k=hf_cfg.num_experts_per_tok,
-            activation=cfg.activation,
-            drop_tokens=False)
-
-        def mlp_fn(c, p, x, _moe=moe_cfg):
-            return moe_forward(_moe, p, x, is_training=False)
-    model = RaggedInferenceModel(cfg, params, mesh=mesh, mlp_fn=mlp_fn)
+    model = RaggedInferenceModel(cfg, params, mesh=mesh)
     return InferenceEngineV2(model, engine_config)

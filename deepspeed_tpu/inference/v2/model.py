@@ -38,6 +38,17 @@ class RaggedInferenceModel:
                  attention_impl: Optional[str] = None):
         self.cfg = cfg
         self.mesh = mesh
+        if mlp_fn is None and cfg.moe_num_experts > 0:
+            # self-wire the routed MoE mlp (mixtral): drop_tokens=False —
+            # inference must not zero out capacity-overflow tokens
+            from ...moe.layer import MoEConfig, moe_forward
+            moe_cfg = MoEConfig(num_experts=cfg.moe_num_experts,
+                                top_k=cfg.moe_top_k,
+                                activation=cfg.activation,
+                                drop_tokens=False)
+
+            def mlp_fn(c, p, x, _moe=moe_cfg):
+                return moe_forward(_moe, p, x, is_training=False)
         self.mlp_fn = mlp_fn
         # implementation chosen through the registry/heuristics seam
         # (reference heuristics.instantiate_attention); attention_impl

@@ -59,6 +59,10 @@ class TransformerConfig:
     qkv_bias: bool = False
     # x + attn(ln1 x) + mlp(ln2 x) (GPT-NeoX use_parallel_residual)
     parallel_residual: bool = False
+    # MoE geometry (mixtral): >0 means the mlp block holds stacked
+    # expert weights and forward needs a routed mlp_fn
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
     tie_embeddings: bool = False
     use_bias: bool = False
     dropout: float = 0.0
@@ -306,6 +310,24 @@ def _flash_ok(cfg: TransformerConfig, n_heads: int, n_kv: int,
             and head_shards <= n_kv)
 
 
+def _divisible_head_axes(n: int, axes=("seq", "tensor")) -> tuple:
+    """Maximal prefix of ``axes`` (present in the mesh) whose sizes all
+    divide ``n`` exactly — GSPMD pads non-divisible shardings, which
+    costs an involuntary full rematerialization per transition."""
+    mesh = _ambient_mesh()
+    if mesh is None:
+        return ()
+    out = []
+    for a in axes:
+        size = mesh.shape.get(a, 1)
+        if size > 1:
+            if n % size != 0:
+                break
+            out.append(a)
+            n //= size
+    return tuple(out)
+
+
 def dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v,
                           mask: Optional[jax.Array]) -> jax.Array:
     """Grouped-query attention, fp32 softmax.  q: [B,S,H,D], k/v: [B,S,K,D].
@@ -313,17 +335,31 @@ def dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v,
     Hot op #1 (reference csrc/transformer softmax/attention kernels).
     This dense einsum formulation serves arbitrary masks and non-TPU CI;
     the pure-causal training path uses flash_dot_product_attention.
+
+    GQA sharding: the head dim splits into (k, g); when the Ulysses head
+    shards exceed the kv-head count, k takes the axes that divide it and
+    g takes the remainder, keeping every intermediate exactly-sharded
+    (no GSPMD padding -> no involuntary remat in fwd or transpose).
     """
     b, s, hq, dd = q.shape
     k_heads = kv_k.shape[2]
     groups = hq // k_heads
+    k_axes = _divisible_head_axes(k_heads)
+    g_axes = _divisible_head_axes(
+        groups, tuple(a for a in ("seq", "tensor") if a not in k_axes))
     q = q.reshape(b, s, k_heads, groups, dd)
+    q = _constrain(q, BATCH, None, k_axes or None, g_axes or None, None)
+    kv_k = _constrain(kv_k, BATCH, None, k_axes or None, None)
+    kv_v = _constrain(kv_v, BATCH, None, k_axes or None, None)
     scores = jnp.einsum("bskgd,btkd->bkgst", q, kv_k) / np.sqrt(dd)
     scores = scores.astype(jnp.float32)
     if mask is not None:
         scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    scores = _constrain(scores, BATCH, k_axes or None, g_axes or None,
+                        None, None)
     probs = jax.nn.softmax(scores, axis=-1).astype(kv_v.dtype)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, kv_v)
+    out = _constrain(out, BATCH, None, k_axes or None, g_axes or None, None)
     return out.reshape(b, s, hq, dd)
 
 
@@ -345,9 +381,14 @@ def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
     # Ulysses resharding: tokens seq-sharded -> heads ('seq'+'tensor')-sharded.
     # XLA materializes this as the two all-to-alls of reference
     # sequence/layer.py:65, but fused into the surrounding program.
-    q = _constrain(q, BATCH, None, ("seq", "tensor"), None)
-    k = _constrain(k, BATCH, None, ("seq", "tensor") if cfg.kv_heads > 1 else None, None)
-    v = _constrain(v, BATCH, None, ("seq", "tensor") if cfg.kv_heads > 1 else None, None)
+    # kv heads take only the axes that DIVIDE them (GQA may have fewer kv
+    # heads than head shards; padding a non-divisible sharding costs an
+    # involuntary full remat per transition).
+    q_axes = _divisible_head_axes(cfg.num_heads)
+    kv_axes = _divisible_head_axes(cfg.kv_heads)
+    q = _constrain(q, BATCH, None, q_axes or None, None)
+    k = _constrain(k, BATCH, None, kv_axes or None, None)
+    v = _constrain(v, BATCH, None, kv_axes or None, None)
     if use_flash:
         out = flash_dot_product_attention(cfg, q, k, v)
     else:
@@ -431,12 +472,17 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
+    # Gather from an explicitly replicated table: the ZeRO JIT all-gather
+    # of [V,E] happens once, the gather output is then born replicated and
+    # the batch/seq constraint below is a cheap local slice (letting XLA
+    # derive the output sharding from a vocab/fsdp-sharded table instead
+    # triggers an involuntary full remat of the gathered activations).
+    table = _constrain(params["embed"]["tokens"].astype(cfg.dtype))
     if cfg.sparse_gradients:
         from ..runtime.sparse_tensor import embedding_lookup
-        x = embedding_lookup(params["embed"]["tokens"].astype(cfg.dtype),
-                             input_ids)
+        x = embedding_lookup(table, input_ids)
     else:
-        x = params["embed"]["tokens"].astype(cfg.dtype)[input_ids]
+        x = table[input_ids]
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
     x = _constrain(x, BATCH, "seq", None)

@@ -264,3 +264,13 @@ class TestHFImportBreadth:
         outs = generate(eng, [[1, 5, 9, 2]], SamplingParams(max_new_tokens=3))
         assert len(outs[0]) == 3
         assert all(0 <= t < 128 for t in outs[0])
+
+    def test_mixtral_v1_init_inference_generates(self):
+        """v1 init_inference must also self-wire the MoE mlp (the config
+        carries moe geometry; regression: dense _mlp_block crashed on
+        rank-3 expert weights)."""
+        import deepspeed_tpu as dst
+        hf = _tiny_hf_mixtral().eval()
+        eng = dst.init_inference(hf, dtype="float32")
+        out = eng.generate([[1, 5, 9, 2]], max_new_tokens=3)
+        assert np.asarray(out).shape[-1] >= 3
