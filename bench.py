@@ -132,7 +132,10 @@ def bench_fastgen(jax):
             ttfts = [first_t[i] - submit_t[i] for i in reqs if i in first_t]
             return total, ttfts, done_tokens
 
-        run(range(min(4, n_req)))  # warmup: compile prefill/decode buckets
+        # warmup with the FULL request set: build_batch buckets (S, Q, P)
+        # to powers of two, so only an identical run precompiles every
+        # bucket shape the measured run will hit
+        run(range(n_req))
         total, ttfts, done_tokens = run(range(n_req))
         ttfts.sort()
         return {

@@ -129,8 +129,11 @@ class InferenceEngine:
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
         params = self._model.params
+        # mlp_fn: MoE models (mixtral) carry a routed mlp the ragged model
+        # self-wired; the dense fallback cannot consume stacked experts
         return T.forward(self._model.cfg, params, input_ids,
-                         attention_mask=attention_mask)
+                         attention_mask=attention_mask,
+                         mlp_fn=self._model.mlp_fn)
 
     __call__ = forward
 

@@ -145,6 +145,10 @@ class PipelineConfig(DeepSpeedConfigModel):
     seed_layers: bool = False
     activation_checkpoint_interval: int = 0
     micro_batches: Optional[int] = None  # default: gradient_accumulation_steps
+    # "1f1b": loss fused into the last stage, no [M, ...] output buffer
+    # (memory bounded like reference TrainSchedule); "gpipe": stack all
+    # micro-batch outputs (needed when callers want logits back)
+    schedule: str = "1f1b"
 
 
 class TensorParallelConfig(DeepSpeedConfigModel):

@@ -23,12 +23,17 @@ XLA the entire pipelined step is ONE compiled program:
   activation-checkpointed stages          ``jax.checkpoint`` on the stage
   (module.py:340 exec_range_func)         body (saves only stage I/O)
 
-Memory/throughput model: GPipe-style schedule with M micro-batches and S
-stages runs T = M + S - 1 ticks (bubble fraction (S-1)/T); rematerialized
-stage bodies keep live activations at O(T) stage-inputs per device, the
-same bound the reference's 1F1B + activation checkpointing achieves.
-Tensor/sequence/ZeRO axes stay in GSPMD "auto" mode inside the loop, so
-one program composes PP with TP/SP/DP/ZeRO shardings.
+Memory/throughput model: both schedules run T = M + S - 1 ticks (bubble
+fraction (S-1)/T).  The default "1f1b" schedule fuses embedding into
+stage 0 and loss into the last stage, so neither the [M, b, s, e]
+embedding/output buffers nor any full-batch logits ever materialize —
+the role the reference's 1F1B ``TrainSchedule`` (schedule.py:189) plays
+for activation memory.  MEASURED (compiled temp buffers, llama-debug,
+pipe=2 x data=4): 2.2x below the "gpipe" stack-outputs schedule at M=8
+and 3.1x at M=16 (tests/test_pipeline.py
+test_1f1b_schedule_uses_less_memory_than_gpipe keeps the ordering
+honest).  Tensor/sequence/ZeRO axes stay in GSPMD "auto" mode inside
+the loop, so one program composes PP with TP/SP/DP/ZeRO shardings.
 """
 
 from __future__ import annotations
@@ -61,7 +66,10 @@ def gpipe_spmd(mesh,
                stage_params: Any,
                x: jax.Array,
                consts: Any = (),
-               remat: bool = True) -> jax.Array:
+               remat: bool = True,
+               first_fn: Optional[Callable] = None,
+               last_fn: Optional[Callable] = None,
+               edge_params: Any = None) -> Any:
     """Differentiable pipelined map over the 'pipe' mesh axis.
 
     ``stage_params`` leaves carry a leading stage dim (global size S,
@@ -70,68 +78,161 @@ def gpipe_spmd(mesh,
     ``stage_fn(local_stage_params, activation, consts, mb_id) ->
     activation`` must be shape-preserving; ``mb_id`` is the micro-batch
     index this stage is processing at the current tick (for indexing
-    per-micro-batch consts such as attention masks).  Returns last-stage
-    outputs [M, ...], replicated over 'pipe'.
+    per-micro-batch consts such as attention masks).
+
+    Two output modes:
+
+    * **stack** (``last_fn=None``): returns last-stage outputs [M, ...],
+      replicated over 'pipe' — the GPipe formulation; the full [M, ...]
+      buffer threads through the scan carry.
+    * **reduce** (``last_fn`` given): ``last_fn(out, consts, mb_id)``
+      runs at the LAST stage as each micro-batch completes and its pytree
+      result is SUMMED over micro-batches — the memory-bounded schedule
+      (reference ``TrainSchedule`` 1F1B, runtime/pipe/schedule.py:189,
+      exists to bound in-flight activations to O(stages); here the same
+      bound comes from never materializing the [M, ...] output buffer or
+      any full-batch logits — the carry holds one boundary activation
+      plus scalar accumulators, and remat re-derives the rest).
+
+    ``first_fn(edge_params, inp_mb, consts, mb_id)`` optionally maps the
+    raw stage-0 input (e.g. token ids) to the activation shape, so the
+    [M, ...] pipeline input can stay narrow (ids, not embeddings).
+
+    ``edge_params`` carries the DIFFERENTIABLE leaves first_fn/last_fn
+    need (embedding table, final norm, lm head).  Everything the region
+    touches must enter through arguments — shard_map closure capture of
+    sharded arrays clashes with the Manual-mode mesh — and ``consts`` is
+    stop-gradiented, so differentiable edge weights get their own slot.
     """
     S = num_stages
     if S == 1:
         sp = jax.tree.map(lambda a: a[0], stage_params)
         body = jax.checkpoint(stage_fn) if remat else stage_fn
         M = x.shape[0]
-        return jax.lax.map(
-            lambda im: body(sp, im[1], consts, im[0]),
-            (jnp.arange(M), x))
+
+        def one(im):
+            mb_id, inp = im
+            act = first_fn(edge_params, inp, consts, mb_id) if first_fn else inp
+            out = body(sp, act, consts, mb_id)
+            return last_fn(edge_params, out, consts, mb_id) if last_fn else out
+        res = jax.lax.map(one, (jnp.arange(M), x))
+        if last_fn:
+            return jax.tree.map(lambda a: a.sum(0), res)
+        return res
 
     param_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
     perm = [(i, (i + 1) % S) for i in range(S)]
-    # x crosses the region boundary in fp32: the shard_map transpose psums
-    # the cotangent of a replicated input over 'pipe', and XLA-CPU's
-    # all-reduce promotion pass miscompiles sub-fp32 all-reduces.  Inside
-    # the region compute proceeds in the original (bf16) dtype.
-    x_dtype = x.dtype
-    x_in = x.astype(jnp.float32) if jnp.issubdtype(x_dtype, jnp.floating) else x
+
+    # shape inference OUTSIDE the Manual-mode region (eval_shape inside
+    # shard_map trips on mixed Manual/Auto mesh contexts)
+    x0_sds = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), x)
+    if first_fn is None:
+        act_sds = jax.tree.leaves(x0_sds)[0]
+    else:
+        act_sds = jax.eval_shape(first_fn, edge_params, x0_sds, consts, 0)
+        act_sds = jax.ShapeDtypeStruct(act_sds.shape, act_sds.dtype)
+    acc_sds = (jax.eval_shape(last_fn, edge_params, act_sds, consts, 0)
+               if last_fn is not None else None)
+    # On XLA-CPU, x and edge_params cross the region boundary in fp32:
+    # the shard_map transpose psums the cotangent of a replicated input
+    # over 'pipe', and XLA-CPU's all-reduce promotion pass miscompiles
+    # sub-fp32 all-reduces.  On TPU the widening is skipped — an fp32
+    # copy of the embedding/lm-head per stage would be real HBM.
+    widen = jax.default_backend() == "cpu"
+
+    def _to_f32(t):
+        if not widen:
+            return t
+        return jax.tree.map(
+            lambda a: a.astype(jnp.float32)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+
+    x_dtypes = jax.tree.map(lambda a: a.dtype, x)
+    x_in = _to_f32(x)
+    edge_dtypes = jax.tree.map(lambda a: a.dtype, edge_params)
+    edge_in = _to_f32(edge_params)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(param_specs, P(), jax.tree.map(lambda _: P(), consts)),
+        in_specs=(param_specs, jax.tree.map(lambda _: P(), edge_params),
+                  P(), jax.tree.map(lambda _: P(), consts)),
         out_specs=P(PIPE_AXIS),
         axis_names=frozenset({PIPE_AXIS}),
         check_vma=False)
-    def region(sp, x, consts):
+    def region(sp, edge, x, consts):
         sp = jax.tree.map(lambda a: a[0], sp)  # [1, ...] -> local stage slice
-        x = x.astype(x_dtype)
+        x = jax.tree.map(lambda a, d: a.astype(d), x, x_dtypes)
+        edge = jax.tree.map(lambda a, d: a.astype(d), edge, edge_dtypes)
         consts = jax.tree.map(jax.lax.stop_gradient, consts)
         stage = jax.lax.axis_index(PIPE_AXIS)
-        M = x.shape[0]
+        M = jax.tree.leaves(x)[0].shape[0]
         T = M + S - 1
         body = jax.checkpoint(stage_fn) if remat else stage_fn
 
-        def tick(carry, t):
-            act, outputs = carry
+        act0 = jnp.zeros(act_sds.shape, act_sds.dtype)
+
+        def tick_common(act, t):
             # stage 0 consumes micro-batch t; later stages consume the
             # activation ppermuted in at the previous tick.  At tick t,
             # stage s is working on micro-batch t - s.
-            x_t = jax.lax.dynamic_index_in_dim(
-                x, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-            inp = jnp.where(stage == 0, x_t, act)
+            mb0 = jnp.clip(t, 0, M - 1)
+            x_t = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, mb0, 0,
+                                                       keepdims=False), x)
+            ent = first_fn(edge, x_t, consts, mb0) if first_fn else x_t
+            inp = jnp.where(stage == 0, ent, act)
             mb_id = jnp.clip(t - stage, 0, M - 1)
-            out = body(sp, inp, consts, mb_id)
-            # last stage finishes micro-batch t-(S-1) at tick t.
-            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
-            upd = jax.lax.dynamic_update_index_in_dim(outputs, out, out_idx, 0)
-            outputs = jnp.where(t >= S - 1, upd, outputs)
+            return body(sp, inp, consts, mb_id)
+
+        if last_fn is None:
+            def tick(carry, t):
+                act, outputs = carry
+                out = tick_common(act, t)
+                # last stage finishes micro-batch t-(S-1) at tick t.
+                out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+                upd = jax.lax.dynamic_update_index_in_dim(
+                    outputs, out, out_idx, 0)
+                outputs = jnp.where(t >= S - 1, upd, outputs)
+                nxt = jax.lax.ppermute(out, PIPE_AXIS, perm)
+                return (nxt, outputs), None
+
+            init = (act0, jnp.zeros((M,) + act0.shape, act0.dtype))
+            (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(T))
+            # Stack per-stage output buffers over 'pipe': the caller
+            # slices the last stage's (the only meaningful one).
+            return outputs[None]
+
+        # reduce mode: accumulate last_fn contributions, no [M] buffer
+        acc0 = jax.tree.map(lambda l: jnp.zeros(l.shape, l.dtype), acc_sds)
+
+        def tick(carry, t):
+            act, acc = carry
+            out = tick_common(act, t)
+            out_mb = jnp.clip(t - (S - 1), 0, M - 1)
+            valid = jnp.logical_and(t >= S - 1, stage == S - 1)
+            # lax.cond: non-last stages (and fill ticks) skip the
+            # norm+head+CE entirely instead of computing and masking it —
+            # the predicate is uniform across the non-pipe mesh axes, so
+            # auto-mode collectives inside the branch stay consistent
+            contrib = jax.lax.cond(
+                valid,
+                lambda: last_fn(edge, out, consts, out_mb),
+                lambda: jax.tree.map(
+                    lambda l: jnp.zeros(l.shape, l.dtype), acc_sds))
+            acc = jax.tree.map(lambda a, c: a + c, acc, contrib)
             nxt = jax.lax.ppermute(out, PIPE_AXIS, perm)
-            return (nxt, outputs), None
+            return (nxt, acc), None
 
-        init = (jnp.zeros_like(x[0]), jnp.zeros_like(x))
-        (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(T))
-        # Stack per-stage output buffers over 'pipe': the caller slices the
-        # last stage's (the only meaningful one).  Cheaper than a masked
-        # psum — the slice lowers to a broadcast from the last stage, and
-        # its transpose routes the loss cotangent back to it alone.
-        return outputs[None]
+        (_, acc), _ = jax.lax.scan(tick, (act0, acc0), jnp.arange(T))
+        # only the last stage accumulated; psum broadcasts it to all
+        acc = jax.tree.map(lambda a: jax.lax.psum(a, PIPE_AXIS), acc)
+        return jax.tree.map(lambda a: a[None], acc)
 
-    return region(stage_params, x_in, consts)[-1]
+    res = region(stage_params, edge_in, x_in, consts)
+    if last_fn is None:
+        return res[-1]
+    return jax.tree.map(lambda a: a[0], res)
 
 
 # ---------------------------------------------------------------------------
@@ -178,12 +279,15 @@ class PipelinedCausalLM:
     layers are split into S contiguous stages of L/S layers each.
     """
 
-    def __init__(self, model, num_stages: int):
+    def __init__(self, model, num_stages: int, schedule: str = "1f1b"):
         self.inner = model
         self.cfg: tfm.TransformerConfig = model.cfg
         if not self.cfg.scan_layers:
             raise ValueError("pipeline requires scan_layers=True (stacked params)")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.num_stages = num_stages
+        self.schedule = schedule
         self.mesh = None  # set by PipelineEngine once topology exists
         if getattr(model, "is_moe", False) or hasattr(model, "moe_cfg"):
             raise NotImplementedError(
@@ -208,11 +312,6 @@ class PipelinedCausalLM:
         else:
             positions = positions.reshape(M, b, s)
 
-        # -- pre-pipeline (replicated over 'pipe') ------------------------
-        x = params["embed"]["tokens"].astype(cfg.dtype)[ids]  # [M,b,s,e]
-        if cfg.pos_emb == "learned":
-            x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
-
         # per-micro-batch mask [M,b,s,s] — each stage indexes its current
         # micro-batch's slice via the mb_id the pipeline loop provides.
         if cfg.causal:
@@ -225,11 +324,15 @@ class PipelinedCausalLM:
         sin, cos = tfm.rope_table(cfg, positions) if cfg.pos_emb == "rope" \
             else (jnp.zeros((M, b, s, 1)), jnp.zeros((M, b, s, 1)))
 
+        labels_all = batch.get("labels")
+        if labels_all is not None:
+            labels_all = labels_all.reshape(M, b, s)
+
         def stage_fn(stage_layers, act, consts, mb_id):
             sin, cos, mask = jax.tree.map(
                 lambda c: jax.lax.dynamic_index_in_dim(c, mb_id, 0,
                                                        keepdims=False),
-                consts)
+                consts[:3])
 
             def layer(carry, lp):
                 y, _ = tfm._layer_body(cfg, lp, carry, sin, cos, mask)
@@ -237,12 +340,76 @@ class PipelinedCausalLM:
             out, _ = jax.lax.scan(layer, act, stage_layers)
             return out
 
+        def head_and_ce(edge, h_mb, consts, mb_id):
+            """Final norm + lm head + CE for ONE micro-batch ->
+            (weighted loss sum, valid-token count)."""
+            h = tfm._norm_apply(cfg, edge["final_norm"], h_mb)
+            if cfg.tie_embeddings:
+                logits = jnp.einsum(
+                    "bse,ve->bsv", h,
+                    edge["embed"]["tokens"].astype(cfg.dtype))
+            else:
+                logits = jnp.einsum(
+                    "bse,ev->bsv", h, edge["lm_head"].astype(cfg.dtype))
+            logits = logits.astype(jnp.float32)
+            _, _, _, c_ids, c_labels, c_am, _ = consts
+            am = (jax.lax.dynamic_index_in_dim(c_am, mb_id, 0,
+                                               keepdims=False)
+                  if c_am is not None else None)
+            def _valid_count(lab, m):
+                # mirror cross_entropy_loss: labels < 0 are ignored, and
+                # the attention mask gates validity
+                v = lab >= 0
+                if m is not None:
+                    v = v & m.astype(bool)
+                return v.sum().astype(jnp.float32)
+
+            if c_labels is not None:
+                lab = jax.lax.dynamic_index_in_dim(c_labels, mb_id, 0,
+                                                   keepdims=False)
+                ce = tfm.cross_entropy_loss(logits, lab, am)
+                count = _valid_count(lab, am)
+            else:
+                lab = jax.lax.dynamic_index_in_dim(c_ids, mb_id, 0,
+                                                   keepdims=False)[:, 1:]
+                am1 = am[:, 1:] if am is not None else None
+                ce = tfm.cross_entropy_loss(logits[:, :-1], lab, am1)
+                count = _valid_count(lab, am1)
+            return ce * count, count
+
+        # micro-batch entry: embed token ids at stage 0 (keeps the [M,...]
+        # pipeline input at id width — the [M,b,s,e] embedding buffer of
+        # the stack schedule never exists)
+        def embed_mb(edge, ids_mb, consts, mb_id):
+            x = edge["embed"]["tokens"].astype(cfg.dtype)[ids_mb]
+            if cfg.pos_emb == "learned":
+                pos_mb = jax.lax.dynamic_index_in_dim(
+                    consts[6], mb_id, 0, keepdims=False)
+                x = x + edge["embed"]["positions"].astype(cfg.dtype)[pos_mb]
+            return x
+
+        if self.schedule == "1f1b":
+            edge = {"embed": params["embed"],
+                    "final_norm": params["final_norm"]}
+            if not cfg.tie_embeddings:
+                edge["lm_head"] = params["lm_head"]
+            am_c = (attn_mask.reshape(M, b, s)
+                    if attn_mask is not None else None)
+            loss_sum, count = gpipe_spmd(
+                self.mesh, self.num_stages, stage_fn, params["layers"], ids,
+                consts=(sin, cos, mask, ids, labels_all, am_c, positions),
+                remat=cfg.remat,
+                first_fn=embed_mb, last_fn=head_and_ce, edge_params=edge)
+            return loss_sum / jnp.maximum(count, 1.0)
+
+        # gpipe: stack all outputs, one full-batch head/CE
+        x = params["embed"]["tokens"].astype(cfg.dtype)[ids]
+        if cfg.pos_emb == "learned":
+            x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
         outputs = gpipe_spmd(self.mesh, self.num_stages, stage_fn,
                              params["layers"], x,
                              consts=(sin, cos, mask),
                              remat=cfg.remat)   # [M,b,s,e]
-
-        # -- post-pipeline (replicated over 'pipe') -----------------------
         h = tfm._norm_apply(cfg, params["final_norm"],
                             outputs.reshape(M * b, s, -1))
         if cfg.tie_embeddings:
@@ -359,7 +526,8 @@ class PipelineEngine(DeepSpeedEngine):
         if isinstance(model, PipelineModule):
             adapter: Any = PipelinedModule(model, stages)
         elif hasattr(model, "cfg") and isinstance(model.cfg, tfm.TransformerConfig):
-            adapter = PipelinedCausalLM(model, stages)
+            adapter = PipelinedCausalLM(model, stages,
+                                         schedule=cfg.pipeline.schedule)
         else:
             raise ValueError(
                 "PipelineEngine needs a PipelineModule or a transformer-family "

@@ -274,3 +274,6 @@ class TestHFImportBreadth:
         eng = dst.init_inference(hf, dtype="float32")
         out = eng.generate([[1, 5, 9, 2]], max_new_tokens=3)
         assert np.asarray(out).shape[-1] >= 3
+        # dense scoring path must route the MoE mlp too
+        logits = eng.forward([[1, 5, 9, 2]])
+        assert np.asarray(logits).shape == (1, 4, 128)
