@@ -95,7 +95,14 @@ def bench_fastgen(jax):
 
         model = LlamaForCausalLM(model_size)
         params = meta.unbox(model.init_params(jax.random.key(0)))
-        eng = InferenceEngineV2(RaggedInferenceModel(model.cfg, params))
+        eng_cfg = None
+        quant = os.environ.get("BENCH_FASTGEN_QUANT")  # e.g. fp8_e4m3
+        if quant:
+            from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig
+            eng_cfg = RaggedInferenceEngineConfig.from_dict(
+                {"quantization": {"enabled": True, "fmt": quant}})
+        eng = InferenceEngineV2(RaggedInferenceModel(model.cfg, params),
+                                eng_cfg)
         rng = np.random.default_rng(0)
         max_prompt = max(8, min(512, model.cfg.max_seq_len - max_new - 1))
         lens = rng.integers(max(1, max_prompt // 4), max_prompt, size=n_req)
@@ -144,6 +151,7 @@ def bench_fastgen(jax):
                 1e3 * ttfts[len(ttfts) // 2], 1) if ttfts else None,
             "fastgen_decode_tok_s": round(done_tokens / total, 1),
             "fastgen_model": model_size,
+            **({"fastgen_quant": quant} if quant else {}),
         }
     except Exception as e:  # noqa: BLE001 — aux leg must not kill the bench
         sys.stderr.write(f"bench: fastgen leg failed: {e}\n")

@@ -28,11 +28,21 @@ class KVCacheUserConfig:
 
 
 @dataclasses.dataclass
+class QuantizationConfig:
+    """Weight-only quantized inference (reference v2 core_ops FP6/FP8
+    quantized GEMM + ``quantization_mode`` engine config)."""
+    enabled: bool = False
+    fmt: str = "fp8_e4m3"   # fp8_e4m3|fp8_e5m2|fp6_e3m2|fp4_e2m1|int8
+
+
+@dataclasses.dataclass
 class RaggedInferenceEngineConfig:
     state_manager: StateManagerConfig = dataclasses.field(
         default_factory=StateManagerConfig)
     kv_cache: KVCacheUserConfig = dataclasses.field(
         default_factory=KVCacheUserConfig)
+    quantization: QuantizationConfig = dataclasses.field(
+        default_factory=QuantizationConfig)
     tp_size: int = 1
 
     @classmethod
@@ -46,5 +56,8 @@ class RaggedInferenceEngineConfig:
         for k, v in kv.items():
             if hasattr(cfg.kv_cache, k):
                 setattr(cfg.kv_cache, k, v)
+        for k, v in d.get("quantization", {}).items():
+            if hasattr(cfg.quantization, k):
+                setattr(cfg.quantization, k, v)
         cfg.tp_size = d.get("tensor_parallel", {}).get("tp_size", 1)
         return cfg

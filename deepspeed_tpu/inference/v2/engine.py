@@ -47,6 +47,11 @@ class InferenceEngineV2:
                  config: Optional[RaggedInferenceEngineConfig] = None):
         self._config = config or RaggedInferenceEngineConfig()
         self._model = model
+        if self._config.quantization.enabled:
+            # NOTE: the engine takes ownership of the model — this
+            # rewrites model.params in place (quantize_weights is
+            # idempotent per format and refuses a format change)
+            model.quantize_weights(self._config.quantization.fmt)
         kv_user = self._config.kv_cache
         if not model.kv_config_explicit:
             # user config wins over the model's default cache geometry;

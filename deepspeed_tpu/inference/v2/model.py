@@ -97,6 +97,51 @@ class RaggedInferenceModel:
         self.params = params
         self._step_cache: Dict[Tuple[int, int, int], Callable] = {}
 
+    # -- weight-only quantization ------------------------------------------
+    def quantize_weights(self, fmt: str = "fp8_e4m3") -> None:
+        """Quantize the per-layer projection weights channelwise into
+        ``fmt`` storage (reference inference v2 core_ops quantized GEMM,
+        FP6/FP8): HBM traffic per decode step halves (fp8) or better;
+        dequant fuses into each einsum's operand feed via
+        models/transformer._wval.  Norm scales, biases, embeddings and
+        the lm head stay full precision (quality-critical, small).
+
+        Rewrites ``self.params`` (callers sharing the model object see
+        quantized weights); idempotent for the same ``fmt``, raises on a
+        format change."""
+        from ...ops.fp_quantizer import quantize_channelwise
+        prior = getattr(self, "_quantized_fmt", None)
+        if prior is not None:
+            if prior != fmt:
+                raise ValueError(
+                    f"model already quantized as {prior!r}; cannot "
+                    f"re-quantize as {fmt!r}")
+            return
+        self._quantized_fmt = fmt
+
+        def q_block(block, batch_dims):
+            out = {}
+            for k2, v in block.items():
+                if (k2.startswith("w") and hasattr(v, "ndim")
+                        and v.ndim >= 2 + batch_dims):
+                    out[k2] = quantize_channelwise(v, fmt,
+                                                   batch_dims=batch_dims)
+                else:
+                    out[k2] = v
+            return out
+
+        layers = self.params["layers"]
+        if isinstance(layers, dict) and "attn" in layers:   # scan-stacked
+            # leading layers dim gets per-layer scales
+            layers = dict(layers, attn=q_block(layers["attn"], 1),
+                          mlp=q_block(layers["mlp"], 1))
+        else:                                               # per-layer
+            layers = {k2: dict(lp, attn=q_block(lp["attn"], 0),
+                               mlp=q_block(lp["mlp"], 0))
+                      for k2, lp in layers.items()}
+        self.params = dict(self.params, layers=layers)
+        self._step_cache.clear()
+
     # -- sharding of the KV cache ------------------------------------------
     def kv_sharding(self) -> Optional[jax.sharding.Sharding]:
         if self.mesh is None:
@@ -164,9 +209,9 @@ class RaggedInferenceModel:
         dtype = cfg.dtype
         h = self._norm(lp["norm1"], x)
         ap = lp["attn"]
-        q = jnp.einsum("sqe,ehd->sqhd", h, ap["wq"].astype(dtype))
-        k = jnp.einsum("sqe,ekd->sqkd", h, ap["wk"].astype(dtype))
-        v = jnp.einsum("sqe,ekd->sqkd", h, ap["wv"].astype(dtype))
+        q = jnp.einsum("sqe,ehd->sqhd", h, T._wval(ap["wq"], dtype))
+        k = jnp.einsum("sqe,ekd->sqkd", h, T._wval(ap["wk"], dtype))
+        v = jnp.einsum("sqe,ekd->sqkd", h, T._wval(ap["wv"], dtype))
         if cfg.use_bias or cfg.qkv_bias:
             q = q + ap["bq"].astype(dtype)
             k = k + ap["bk"].astype(dtype)
@@ -179,7 +224,7 @@ class RaggedInferenceModel:
             kv_layer = write_kv(kv_layer, k, v, page_table, start_pos,
                                 q_lens)
         attn = self._attention(q, kv_layer, page_table, start_pos, q_lens)
-        out = jnp.einsum("sqhd,hde->sqe", attn, ap["wo"].astype(dtype))
+        out = jnp.einsum("sqhd,hde->sqe", attn, T._wval(ap["wo"], dtype))
         if cfg.use_bias:
             out = out + ap["bo"].astype(dtype)
         if cfg.parallel_residual:

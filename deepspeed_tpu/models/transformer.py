@@ -186,6 +186,16 @@ def _constrain(x: jax.Array, *spec) -> jax.Array:
         return x
 
 
+def _wval(p, dtype) -> jax.Array:
+    """Weight leaf -> compute-dtype array.  Channel-quantized leaves
+    ({'q', 'scale'} from ops/fp_quantizer.quantize_channelwise) dequant
+    lazily — XLA fuses the cast+scale into the consuming einsum."""
+    if isinstance(p, dict) and "q" in p:
+        from ..ops.fp_quantizer import dequantize_channelwise
+        return dequantize_channelwise(p, dtype)
+    return p.astype(dtype)
+
+
 def _norm_apply(cfg: TransformerConfig, p, x: jax.Array) -> jax.Array:
     x32 = x.astype(jnp.float32)
     if cfg.norm == "rmsnorm":
@@ -401,14 +411,14 @@ def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
 
 def _mlp_block(cfg: TransformerConfig, p, x):
     dtype = cfg.dtype
-    up = jnp.einsum("bse,ef->bsf", x, p["wi"].astype(dtype))
+    up = jnp.einsum("bse,ef->bsf", x, _wval(p["wi"], dtype))
     if cfg.use_bias:
         up = up + p["bi"].astype(dtype)
-    gate = jnp.einsum("bse,ef->bsf", x, p["wg"].astype(dtype)) \
+    gate = jnp.einsum("bse,ef->bsf", x, _wval(p["wg"], dtype)) \
         if "wg" in p else None
     h = _activation(cfg, gate, up) if gate is not None else _activation(cfg, None, up)
     h = _constrain(h, BATCH, "seq", "tensor")
-    out = jnp.einsum("bsf,fe->bse", h, p["wo"].astype(dtype))
+    out = jnp.einsum("bsf,fe->bse", h, _wval(p["wo"], dtype))
     if cfg.use_bias:
         out = out + p["bo"].astype(dtype)
     return _constrain(out, BATCH, "seq", None)

@@ -80,7 +80,7 @@ def init_moe_params(cfg: MoEConfig, hidden: int, ffn: int, rng: jax.Array,
 
 # shared with the dense transformer core (one source of truth for the
 # activation dispatch and the mesh-context-degrading sharding constraint)
-from ..models.transformer import _constrain
+from ..models.transformer import _constrain, _wval
 
 
 def _expert_act(cfg: MoEConfig, gate, up):
@@ -114,11 +114,12 @@ def moe_forward(cfg: MoEConfig, params, x: jax.Array,
                             gate_out.dispatch_mask.astype(dtype), xf)
     dispatched = _constrain(dispatched, "expert", None, None)
 
-    # grouped expert FFN (stacked weights, batched einsum)
-    wi = params["wi"].astype(dtype)
-    wo = params["wo"].astype(dtype)
+    # grouped expert FFN (stacked weights, batched einsum); _wval
+    # dequantizes channel-quantized leaves lazily (weight-only inference)
+    wi = _wval(params["wi"], dtype)
+    wo = _wval(params["wo"], dtype)
     up = jnp.einsum("ecd,edf->ecf", dispatched, wi)
-    gate_h = jnp.einsum("ecd,edf->ecf", dispatched, params["wg"].astype(dtype)) \
+    gate_h = jnp.einsum("ecd,edf->ecf", dispatched, _wval(params["wg"], dtype)) \
         if "wg" in params else None
     h = _expert_act(cfg, gate_h, up)
     expert_out = jnp.einsum("ecf,efd->ecd", h, wo)

@@ -154,6 +154,42 @@ def fp8_einsum(spec: str, x: jax.Array, q: jax.Array, scales: jax.Array,
     return jnp.einsum(spec, x, w)
 
 
+def quantize_channelwise(w: jax.Array, fmt: str = "fp8_e4m3",
+                         batch_dims: int = 0) -> dict:
+    """Weight-only quantization preserving shape: values stored in the
+    low-precision dtype, one fp32 scale per last-axis channel (kept with
+    singleton reduced dims so ``q * scale`` broadcasts for any rank).
+
+    ``batch_dims`` leading dims (a scan-stacked layers dim, experts)
+    each get their own scales rather than sharing one.
+
+    The W8A16/W6A16 layout for inference (reference inference v2
+    core_ops FP6 quantized GEMM, ``inference/v2/kernels/core_ops/``):
+    the dequant fuses into the consuming matmul's operand feed, so the
+    full-precision weight never materializes in HBM — weights stream at
+    1 byte/elem (fp8) instead of 2, the lever that matters for
+    HBM-bandwidth-bound decode."""
+    axes = tuple(range(batch_dims, w.ndim - 1))
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes,
+                     keepdims=True)
+    if fmt == "int8":
+        scale = jnp.maximum(absmax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                     -127, 127).astype(jnp.int8)
+        return {"q": q, "scale": scale.astype(jnp.float32)}
+    store_dtype, max_mag, _ = _FORMATS[fmt]
+    scale = jnp.maximum(absmax, 1e-12) / max_mag
+    y = w.astype(jnp.float32) / scale
+    if fmt.startswith("fp6"):
+        y = _snap_to_grid(y, _fp6_grid_cached(fmt))
+    return {"q": y.astype(store_dtype), "scale": scale.astype(jnp.float32)}
+
+
+def dequantize_channelwise(packed: dict, dtype=jnp.bfloat16) -> jax.Array:
+    return (packed["q"].astype(jnp.float32)
+            * packed["scale"]).astype(dtype)
+
+
 class QuantizedTensor:
     """Self-describing quantized buffer: values + scales + original
     shape/dtype.  The reference packs scales into the tail of the int8

@@ -180,8 +180,16 @@ def gpipe_spmd(mesh,
             x_t = jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(a, mb0, 0,
                                                        keepdims=False), x)
-            ent = first_fn(edge, x_t, consts, mb0) if first_fn else x_t
-            inp = jnp.where(stage == 0, ent, act)
+            if first_fn is None:
+                inp = jnp.where(stage == 0, x_t, act)
+            else:
+                # only stage 0 pays the embedding gather (predicate is
+                # uniform across the non-pipe mesh axes, like last_fn)
+                inp = jax.lax.cond(
+                    stage == 0,
+                    lambda: first_fn(edge, x_t, consts, mb0).astype(
+                        act.dtype),
+                    lambda: act)
             mb_id = jnp.clip(t - stage, 0, M - 1)
             return body(sp, inp, consts, mb_id)
 
@@ -449,11 +457,15 @@ class PipelinedModule:
     ``module.loss_fn(out, y) -> scalar``.
     """
 
-    def __init__(self, module: PipelineModule, num_stages: int):
+    def __init__(self, module: PipelineModule, num_stages: int,
+                 schedule: str = "1f1b"):
         if module.loss_fn is None:
             raise ValueError("PipelineModule needs loss_fn for training")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.module = module
         self.num_stages = num_stages
+        self.schedule = schedule
         self.mesh = None
         L = len(module)
         if L % num_stages != 0:
@@ -495,6 +507,21 @@ class PipelinedModule:
             out, _ = jax.lax.scan(layer, act, stage_layers)
             return out
 
+        if self.schedule == "1f1b":
+            loss_fn = self.module.loss_fn
+
+            def last_fn(edge, out, consts, mb_id):
+                y_mb = jax.lax.dynamic_index_in_dim(consts[0], mb_id, 0,
+                                                    keepdims=False)
+                return loss_fn(out, y_mb)
+
+            total = gpipe_spmd(self.mesh, self.num_stages, stage_fn,
+                               params, x, consts=(y,), last_fn=last_fn)
+            # loss_fn returns a per-micro-batch mean; micro-batches are
+            # equally sized on this path, so the flat mean is the mean
+            # of means
+            return total / M
+
         outputs = gpipe_spmd(self.mesh, self.num_stages, stage_fn,
                              params, x)
         flat_out = outputs.reshape((-1,) + outputs.shape[2:])
@@ -524,7 +551,8 @@ class PipelineEngine(DeepSpeedEngine):
         cfg = load_config(config)
         stages = cfg.tpu.mesh.get("pipe", cfg.pipeline.stages or 1)
         if isinstance(model, PipelineModule):
-            adapter: Any = PipelinedModule(model, stages)
+            adapter: Any = PipelinedModule(model, stages,
+                                           schedule=cfg.pipeline.schedule)
         elif hasattr(model, "cfg") and isinstance(model.cfg, tfm.TransformerConfig):
             adapter = PipelinedCausalLM(model, stages,
                                          schedule=cfg.pipeline.schedule)

@@ -412,3 +412,87 @@ class TestModuleRegistry:
         params = fmeta.unbox(init_params(cfg, jax.random.key(0)))
         m = RaggedInferenceModel(cfg, params, attention_impl="dense_gather")
         assert callable(m._attention)
+
+
+# ---------------------------------------------------------------------------
+# weight-only quantized inference
+# ---------------------------------------------------------------------------
+
+class TestQuantizedInference:
+    def _engine(self, quant=None):
+        from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                                RaggedInferenceEngineConfig,
+                                                RaggedInferenceModel)
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        model = LlamaForCausalLM("debug", dtype=jnp.float32)
+        params = meta.unbox(model.init_params(jax.random.key(0)))
+        cfg = RaggedInferenceEngineConfig.from_dict(
+            {"quantization": quant} if quant else {})
+        cfg.kv_cache.num_pages = 64
+        return InferenceEngineV2(RaggedInferenceModel(model.cfg, params), cfg)
+
+    def test_channelwise_roundtrip(self):
+        from deepspeed_tpu.ops.fp_quantizer import (dequantize_channelwise,
+                                                    quantize_channelwise)
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(64, 3, 32)), jnp.float32)
+        for fmt, rel in [("fp8_e4m3", 2 ** -3), ("int8", 2 ** -7),
+                         ("fp6_e3m2", 2 ** -2), ("fp4_e2m1", 2 ** -1)]:
+            packed = quantize_channelwise(w, fmt)
+            assert packed["q"].shape == w.shape
+            assert packed["scale"].shape == (1, 1, 32)
+            back = np.asarray(dequantize_channelwise(packed, jnp.float32))
+            err = np.abs(back - np.asarray(w))
+            bound = np.abs(np.asarray(w)).max(axis=(0, 1), keepdims=True) * rel
+            assert (err <= bound + 1e-6).mean() > 0.99, fmt
+
+    @pytest.mark.parametrize("fmt", ["fp8_e4m3", "int8"])
+    def test_quantized_generate_close_to_full_precision(self, fmt):
+        from deepspeed_tpu.inference.v2 import SamplingParams, generate
+        prompts = [[1, 5, 9, 2, 17], [3, 4]]
+        sp = SamplingParams(max_new_tokens=4, temperature=0.0)
+        full = generate(self._engine(), prompts, sp)
+        quant = generate(self._engine({"enabled": True, "fmt": fmt}),
+                         prompts, sp)
+        # greedy decode from the same weights: 8-bit channelwise noise
+        # rarely flips an argmax on a random-init debug model; require
+        # most tokens identical rather than exact equality
+        flat_f = [t for seq in full for t in seq]
+        flat_q = [t for seq in quant for t in seq]
+        same = sum(a == b for a, b in zip(flat_f, flat_q))
+        assert same >= len(flat_f) // 2, (full, quant)
+
+    def test_quantized_params_are_small(self):
+        eng_q = self._engine({"enabled": True, "fmt": "fp8_e4m3"})
+        layers = eng_q._model.params["layers"]
+        wq = layers["attn"]["wq"]
+        assert isinstance(wq, dict) and wq["q"].dtype == jnp.float8_e4m3fn
+        # norms/embeddings untouched
+        assert not isinstance(layers["norm1"]["scale"], dict)
+        assert not isinstance(eng_q._model.params["embed"]["tokens"], dict)
+
+    def test_quantized_moe_generates(self):
+        """MoE expert weights route through _wval too (regression:
+        moe_forward crashed on {'q','scale'} dict leaves)."""
+        from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                                RaggedInferenceEngineConfig,
+                                                RaggedInferenceModel,
+                                                SamplingParams, generate)
+        from deepspeed_tpu.models.mixtral import MixtralForCausalLM
+        model = MixtralForCausalLM("debug", num_experts=2, top_k=1,
+                                   dtype=jnp.float32)
+        import dataclasses
+        cfg = dataclasses.replace(model.cfg, moe_num_experts=2, moe_top_k=1)
+        params = meta.unbox(model.init_params(jax.random.key(0)))
+        ecfg = RaggedInferenceEngineConfig.from_dict(
+            {"quantization": {"enabled": True, "fmt": "fp8_e4m3"}})
+        ecfg.kv_cache.num_pages = 64
+        eng = InferenceEngineV2(RaggedInferenceModel(cfg, params), ecfg)
+        outs = generate(eng, [[1, 5, 9]], SamplingParams(max_new_tokens=3))
+        assert len(outs[0]) == 3
+
+    def test_requantize_format_change_rejected(self):
+        eng = self._engine({"enabled": True, "fmt": "fp8_e4m3"})
+        with pytest.raises(ValueError):
+            eng._model.quantize_weights("int8")
+        eng._model.quantize_weights("fp8_e4m3")  # same fmt: no-op
