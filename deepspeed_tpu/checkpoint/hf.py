@@ -56,6 +56,25 @@ def _unpermute_rope(w: np.ndarray, n_heads: int, head_dim: int,
     return w[:, 0] if squeeze else w
 
 
+def _map_hf_act(name: str) -> str:
+    """HF activation-name -> core activation.  HF's "gelu" is exact erf;
+    the tanh approximation goes by gelu_new/gelu_fast/gelu_pytorch_tanh."""
+    table = {"gelu": "gelu_exact", "gelu_new": "gelu", "gelu_fast": "gelu",
+             "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+    try:
+        return table[name]
+    except KeyError:
+        raise ValueError(f"unsupported HF activation {name!r} "
+                         f"(supported: {sorted(table)})") from None
+
+
+def _rot_dims(head_dim: int, pct: float) -> int:
+    """Even rotary lane count — must mirror rope_table's rounding
+    (models/transformer.py)."""
+    rot = int(head_dim * pct)
+    return rot - rot % 2
+
+
 def llama_config_from_hf(hf_cfg) -> TransformerConfig:
     """Map a transformers LlamaConfig/MistralConfig to TransformerConfig."""
     return TransformerConfig(
@@ -201,7 +220,8 @@ def gpt_neox_config_from_hf(hf_cfg) -> TransformerConfig:
         num_kv_heads=hf_cfg.num_attention_heads,
         max_seq_len=hf_cfg.max_position_embeddings,
         norm="layernorm", norm_eps=hf_cfg.layer_norm_eps,
-        activation="gelu", pos_emb="rope",
+        activation=_map_hf_act(getattr(hf_cfg, "hidden_act", "gelu")),
+        pos_emb="rope",
         rope_theta=getattr(hf_cfg, "rotary_emb_base", 10000.0),
         rope_pct=getattr(hf_cfg, "rotary_pct", 1.0),
         parallel_residual=getattr(hf_cfg, "use_parallel_residual", True),
@@ -219,7 +239,7 @@ def load_gpt_neox(state_dict: Dict[str, Any], cfg: TransformerConfig,
     sd = {k.removeprefix("gpt_neox."): _np(v)
           for k, v in state_dict.items()}
     E, H, D = cfg.hidden_size, cfg.num_heads, cfg.dims_per_head
-    rot = int(D * cfg.rope_pct) - int(D * cfg.rope_pct) % 2
+    rot = _rot_dims(D, cfg.rope_pct)
     layers = []
     for i in range(cfg.num_layers):
         p = f"layers.{i}."
@@ -346,6 +366,289 @@ def load_gpt2(state_dict: Dict[str, Any], cfg: TransformerConfig,
     return _cast(params, dtype)
 
 
+def falcon_config_from_hf(hf_cfg) -> TransformerConfig:
+    """Falcon family (reference v2 ``model_implementations/falcon``).
+
+    falcon-7b: MQA + parallel attn/mlp sharing ONE input layernorm;
+    falcon-40b/falcon2: GQA "new decoder architecture" with ln_attn +
+    ln_mlp (or a single shared ln when num_ln_in_parallel_attn == 1).
+    The shared-ln variants are expressed exactly by duplicating the ln
+    weights into norm1/norm2 of the parallel-residual core."""
+    if getattr(hf_cfg, "alibi", False):
+        raise ValueError("falcon alibi position encoding not supported "
+                         "(rope falcons only)")
+    if getattr(hf_cfg, "bias", False):
+        raise ValueError("falcon with linear biases not supported")
+    H = hf_cfg.num_attention_heads
+    if hf_cfg.new_decoder_architecture:
+        K = hf_cfg.num_kv_heads
+    elif getattr(hf_cfg, "multi_query", True):
+        K = 1
+    else:
+        K = H
+    parallel = (hf_cfg.new_decoder_architecture
+                or getattr(hf_cfg, "parallel_attn", True))
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=getattr(hf_cfg, "ffn_hidden_size",
+                                  4 * hf_cfg.hidden_size),
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=H, num_kv_heads=K,
+        max_seq_len=getattr(hf_cfg, "max_position_embeddings", 2048),
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_epsilon,
+        activation=_map_hf_act(getattr(hf_cfg, "activation", "gelu")),
+        pos_emb="rope",
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        parallel_residual=parallel,
+        tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        use_bias=False, dtype=jnp.bfloat16)
+
+
+def load_falcon(state_dict: Dict[str, Any], cfg: TransformerConfig,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    """HF Falcon state dict -> param tree.
+
+    ``query_key_value`` packs [H/K q-heads, k, v] per kv-head group.
+    That single grouped layout covers every falcon variant: with K=1 it
+    reduces to the multi_query [H q, k, v] packing and with K=H to the
+    per-head [q, k, v] interleave, so (H, K) from the config determine
+    the split with no arch flags needed.  Falcon rotates half-split
+    natively, so q/k rows are re-laned to interleaved."""
+    sd = {k.removeprefix("transformer."): _np(v)
+          for k, v in state_dict.items()}
+    E, H, K, D = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                  cfg.dims_per_head)
+    g = H // K
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        w = sd[p + "self_attention.query_key_value.weight"]
+        w = w.reshape(K, g + 2, D, E)
+        wq = w[:, :g].reshape(H * D, E)
+        wk = w[:, g].reshape(K * D, E)
+        wv = w[:, g + 1].reshape(K * D, E)
+        wq = _unpermute_rope(wq, H, D)
+        wk = _unpermute_rope(wk, K, D)
+        if cfg.parallel_residual:
+            # new arch: ln_attn/ln_mlp when present (num_ln == 2); else
+            # ONE shared input_layernorm feeds both branches
+            if p + "ln_attn.weight" in sd:
+                n1 = {"scale": sd[p + "ln_attn.weight"],
+                      "bias": sd[p + "ln_attn.bias"]}
+                n2 = {"scale": sd[p + "ln_mlp.weight"],
+                      "bias": sd[p + "ln_mlp.bias"]}
+            else:
+                n1 = {"scale": sd[p + "input_layernorm.weight"],
+                      "bias": sd[p + "input_layernorm.bias"]}
+                n2 = dict(n1)
+        else:
+            n1 = {"scale": sd[p + "input_layernorm.weight"],
+                  "bias": sd[p + "input_layernorm.bias"]}
+            n2 = {"scale": sd[p + "post_attention_layernorm.weight"],
+                  "bias": sd[p + "post_attention_layernorm.bias"]}
+        layers.append({
+            "attn": {
+                "wq": wq.T.reshape(E, H, D),
+                "wk": wk.T.reshape(E, K, D),
+                "wv": wv.T.reshape(E, K, D),
+                "wo": sd[p + "self_attention.dense.weight"].T.reshape(H, D, E),
+            },
+            "mlp": {
+                "wi": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                "wo": sd[p + "mlp.dense_4h_to_h.weight"].T,
+            },
+            "norm1": n1, "norm2": n2,
+        })
+    params = {
+        "embed": {"tokens": sd["word_embeddings.weight"]},
+        "layers": _stack(layers) if cfg.scan_layers
+        else {f"layer_{i}": l for i, l in enumerate(layers)},
+        "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"].T
+    return _cast(params, dtype)
+
+
+def opt_config_from_hf(hf_cfg) -> TransformerConfig:
+    """OPT (reference v2 ``model_implementations/opt``): learned
+    positions (with the HF +2 offset folded into the table at load),
+    pre-LN decoder, relu MLP, biases everywhere."""
+    if getattr(hf_cfg, "word_embed_proj_dim",
+               hf_cfg.hidden_size) != hf_cfg.hidden_size:
+        raise ValueError("OPT word_embed_proj_dim != hidden_size "
+                         "(opt-350m style projections) not supported")
+    if not getattr(hf_cfg, "do_layer_norm_before", True):
+        raise ValueError("OPT post-layernorm variants not supported")
+    act = _map_hf_act(hf_cfg.activation_function)
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.ffn_dim,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=hf_cfg.num_attention_heads,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        norm="layernorm", norm_eps=1e-5,
+        activation=act, pos_emb="learned",
+        tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", True),
+        use_bias=True, dtype=jnp.bfloat16)
+
+
+def load_opt(state_dict: Dict[str, Any], cfg: TransformerConfig,
+             dtype=jnp.float32) -> Dict[str, Any]:
+    """HF OPT state dict -> param tree.  ``embed_positions`` carries the
+    HF offset-of-2 (OPTLearnedPositionalEmbedding); dropping the first
+    two rows makes position i index row i+2, matching HF for unpadded
+    sequences."""
+    sd = {k.removeprefix("model.decoder."): _np(v)
+          for k, v in state_dict.items()}
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.dims_per_head
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}."
+        layers.append({
+            "attn": {
+                "wq": sd[p + "self_attn.q_proj.weight"].T.reshape(E, H, D),
+                "wk": sd[p + "self_attn.k_proj.weight"].T.reshape(E, H, D),
+                "wv": sd[p + "self_attn.v_proj.weight"].T.reshape(E, H, D),
+                "wo": sd[p + "self_attn.out_proj.weight"].T.reshape(H, D, E),
+                "bq": sd[p + "self_attn.q_proj.bias"].reshape(H, D),
+                "bk": sd[p + "self_attn.k_proj.bias"].reshape(H, D),
+                "bv": sd[p + "self_attn.v_proj.bias"].reshape(H, D),
+                "bo": sd[p + "self_attn.out_proj.bias"],
+            },
+            "mlp": {
+                "wi": sd[p + "fc1.weight"].T, "bi": sd[p + "fc1.bias"],
+                "wo": sd[p + "fc2.weight"].T, "bo": sd[p + "fc2.bias"],
+            },
+            "norm1": {"scale": sd[p + "self_attn_layer_norm.weight"],
+                      "bias": sd[p + "self_attn_layer_norm.bias"]},
+            "norm2": {"scale": sd[p + "final_layer_norm.weight"],
+                      "bias": sd[p + "final_layer_norm.bias"]},
+        })
+    params = {
+        "embed": {"tokens": sd["embed_tokens.weight"],
+                  "positions": sd["embed_positions.weight"][2:]},
+        "layers": _stack(layers) if cfg.scan_layers
+        else {f"layer_{i}": l for i, l in enumerate(layers)},
+        "final_norm": {"scale": sd["final_layer_norm.weight"],
+                       "bias": sd["final_layer_norm.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"].T
+    return _cast(params, dtype)
+
+
+def phi_config_from_hf(hf_cfg) -> TransformerConfig:
+    """Phi-1/1.5/2 (reference v2 ``model_implementations/phi``):
+    parallel attn+mlp off ONE input layernorm, partial rotary, biases
+    everywhere including the lm_head."""
+    if getattr(hf_cfg, "qk_layernorm", False):
+        raise ValueError("phi qk_layernorm not supported")
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=getattr(hf_cfg, "num_key_value_heads",
+                             hf_cfg.num_attention_heads)
+        or hf_cfg.num_attention_heads,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_eps,
+        activation=_map_hf_act(getattr(hf_cfg, "hidden_act", "gelu_new")),
+        pos_emb="rope",
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        rope_pct=getattr(hf_cfg, "partial_rotary_factor", 1.0),
+        parallel_residual=True,
+        tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        use_bias=True, dtype=jnp.bfloat16)
+
+
+def load_phi(state_dict: Dict[str, Any], cfg: TransformerConfig,
+             dtype=jnp.float32) -> Dict[str, Any]:
+    """HF Phi state dict -> param tree.  The single input_layernorm is
+    duplicated into norm1/norm2 (both parallel branches read the same
+    normed input — exact, not approximate).  Partial-rotary q/k lanes
+    are re-ordered from half-split to interleaved."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    E, H, K, D = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                  cfg.dims_per_head)
+    rot = _rot_dims(D, cfg.rope_pct)
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        wq = _unpermute_rope(sd[p + "self_attn.q_proj.weight"], H, D, rot)
+        wk = _unpermute_rope(sd[p + "self_attn.k_proj.weight"], K, D, rot)
+        bq = _unpermute_rope(sd[p + "self_attn.q_proj.bias"], H, D, rot)
+        bk = _unpermute_rope(sd[p + "self_attn.k_proj.bias"], K, D, rot)
+        ln = {"scale": sd[p + "input_layernorm.weight"],
+              "bias": sd[p + "input_layernorm.bias"]}
+        layers.append({
+            "attn": {
+                "wq": wq.T.reshape(E, H, D),
+                "wk": wk.T.reshape(E, K, D),
+                "wv": sd[p + "self_attn.v_proj.weight"].T.reshape(E, K, D),
+                "wo": sd[p + "self_attn.dense.weight"].T.reshape(H, D, E),
+                "bq": bq.reshape(H, D), "bk": bk.reshape(K, D),
+                "bv": sd[p + "self_attn.v_proj.bias"].reshape(K, D),
+                "bo": sd[p + "self_attn.dense.bias"],
+            },
+            "mlp": {
+                "wi": sd[p + "mlp.fc1.weight"].T,
+                "bi": sd[p + "mlp.fc1.bias"],
+                "wo": sd[p + "mlp.fc2.weight"].T,
+                "bo": sd[p + "mlp.fc2.bias"],
+            },
+            "norm1": ln, "norm2": dict(ln),
+        })
+    params = {
+        "embed": {"tokens": sd["model.embed_tokens.weight"]},
+        "layers": _stack(layers) if cfg.scan_layers
+        else {f"layer_{i}": l for i, l in enumerate(layers)},
+        "final_norm": {"scale": sd["model.final_layernorm.weight"],
+                       "bias": sd["model.final_layernorm.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"].T
+        if "lm_head.bias" in sd:
+            params["lm_head_bias"] = sd["lm_head.bias"]
+    return _cast(params, dtype)
+
+
+def phi3_config_from_hf(hf_cfg) -> TransformerConfig:
+    """Phi-3 (llama-shaped: rmsnorm + SwiGLU + full rope, fused
+    qkv/gate_up projections)."""
+    if getattr(hf_cfg, "rope_scaling", None):
+        raise ValueError("phi3 longrope scaling not supported")
+    return llama_config_from_hf(hf_cfg)
+
+
+def load_phi3(state_dict: Dict[str, Any], cfg: TransformerConfig,
+              dtype=jnp.float32) -> Dict[str, Any]:
+    """HF Phi-3 state dict -> param tree: split fused qkv_proj /
+    gate_up_proj rows into the llama layout, then defer to load_llama."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    H, K, D = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+    F = cfg.intermediate_size
+    out = {}
+    for k, v in sd.items():
+        if k.endswith("self_attn.qkv_proj.weight"):
+            base = k.removesuffix("qkv_proj.weight")
+            out[base + "q_proj.weight"] = v[:H * D]
+            out[base + "k_proj.weight"] = v[H * D:H * D + K * D]
+            out[base + "v_proj.weight"] = v[H * D + K * D:]
+        elif k.endswith("mlp.gate_up_proj.weight"):
+            base = k.removesuffix("gate_up_proj.weight")
+            out[base + "gate_proj.weight"] = v[:F]
+            out[base + "up_proj.weight"] = v[F:]
+        else:
+            out[k] = v
+    return load_llama(out, cfg, dtype)
+
+
 def load_hf_model(model_or_path):
     """Normalize a path-or-instance to a transformers model instance —
     the single place checkpoint-loading policy lives."""
@@ -358,26 +661,17 @@ def load_hf_model(model_or_path):
 
 def from_pretrained(model_or_path, dtype=jnp.float32
                     ) -> Tuple[TransformerConfig, Dict[str, Any]]:
-    """Convert a transformers model instance or local checkpoint dir."""
+    """Convert a transformers model instance or local checkpoint dir.
+
+    Arch dispatch lives in the injection-policy registry
+    (module_inject/policies.py) — ONE place maps ``model_type`` to
+    (config converter, weight loader); raises ValueError naming the
+    supported set for unknown archs."""
     model = load_hf_model(model_or_path)
-    arch = model.config.model_type
-    sd = model.state_dict()
-    if arch in ("llama", "mistral"):
-        cfg = llama_config_from_hf(model.config)
-        return cfg, load_llama(sd, cfg, dtype)
-    if arch == "qwen2":
-        cfg = qwen2_config_from_hf(model.config)
-        return cfg, load_qwen2(sd, cfg, dtype)
-    if arch == "mixtral":
-        cfg = mixtral_config_from_hf(model.config)
-        return cfg, load_mixtral(sd, cfg, dtype)
-    if arch == "gpt_neox":
-        cfg = gpt_neox_config_from_hf(model.config)
-        return cfg, load_gpt_neox(sd, cfg, dtype)
-    if arch == "gpt2":
-        cfg = gpt2_config_from_hf(model.config)
-        return cfg, load_gpt2(sd, cfg, dtype)
-    raise ValueError(f"unsupported HF architecture: {arch!r}")
+    from ..module_inject.policies import replace_policy_for
+    pol = replace_policy_for(model.config.model_type)
+    cfg = pol.config_from_hf(model.config)
+    return cfg, pol.load(model.state_dict(), cfg, dtype)
 
 
 def _stack(layers):

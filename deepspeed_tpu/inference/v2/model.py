@@ -109,7 +109,11 @@ class RaggedInferenceModel:
         Rewrites ``self.params`` (callers sharing the model object see
         quantized weights); idempotent for the same ``fmt``, raises on a
         format change."""
-        from ...ops.fp_quantizer import quantize_channelwise
+        from ...ops.fp_quantizer import (SUPPORTED_FORMATS,
+                                         quantize_channelwise)
+        if fmt not in SUPPORTED_FORMATS:
+            raise ValueError(f"unknown quantization format {fmt!r} "
+                             f"(supported: {sorted(SUPPORTED_FORMATS)})")
         prior = getattr(self, "_quantized_fmt", None)
         if prior is not None:
             if prior != fmt:
@@ -117,15 +121,18 @@ class RaggedInferenceModel:
                     f"model already quantized as {prior!r}; cannot "
                     f"re-quantize as {fmt!r}")
             return
-        self._quantized_fmt = fmt
 
-        def q_block(block, batch_dims):
+        def q_block(block, batch_dims, per_leaf=False):
+            """``per_leaf``: every leading dim beyond the [in, out]
+            matrix gets its own scales — MoE expert weights
+            [layers?, experts, in, out] must not share one absmax
+            across experts (one outlier expert would coarsen all)."""
             out = {}
             for k2, v in block.items():
                 if (k2.startswith("w") and hasattr(v, "ndim")
                         and v.ndim >= 2 + batch_dims):
-                    out[k2] = quantize_channelwise(v, fmt,
-                                                   batch_dims=batch_dims)
+                    bd = v.ndim - 2 if per_leaf else batch_dims
+                    out[k2] = quantize_channelwise(v, fmt, batch_dims=bd)
                 else:
                     out[k2] = v
             return out
@@ -134,12 +141,13 @@ class RaggedInferenceModel:
         if isinstance(layers, dict) and "attn" in layers:   # scan-stacked
             # leading layers dim gets per-layer scales
             layers = dict(layers, attn=q_block(layers["attn"], 1),
-                          mlp=q_block(layers["mlp"], 1))
+                          mlp=q_block(layers["mlp"], 1, per_leaf=True))
         else:                                               # per-layer
             layers = {k2: dict(lp, attn=q_block(lp["attn"], 0),
-                               mlp=q_block(lp["mlp"], 0))
+                               mlp=q_block(lp["mlp"], 0, per_leaf=True))
                       for k2, lp in layers.items()}
         self.params = dict(self.params, layers=layers)
+        self._quantized_fmt = fmt
         self._step_cache.clear()
 
     # -- sharding of the KV cache ------------------------------------------
@@ -201,6 +209,8 @@ class RaggedInferenceModel:
                 if cfg.tie_embeddings
                 else params["lm_head"].astype(cfg.dtype))
         logits = self._unembed(x, q_lens, head)             # [S, V]
+        if "lm_head_bias" in params:  # phi family ships an lm_head bias
+            logits = logits + params["lm_head_bias"].astype(cfg.dtype)
         return logits.astype(jnp.float32), kv
 
     def _layer_body(self, x, lp, kv_layer, *, pos, sin, cos, q_lens,

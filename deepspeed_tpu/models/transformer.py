@@ -49,7 +49,8 @@ class TransformerConfig:
     max_seq_len: int = 4096
     norm: str = "rmsnorm"              # rmsnorm | layernorm
     norm_eps: float = 1e-5
-    activation: str = "silu_gated"     # silu_gated | gelu | gelu_gated
+    # silu_gated | gelu (tanh approx) | gelu_exact | gelu_gated | relu
+    activation: str = "silu_gated"
     pos_emb: str = "rope"              # rope | learned | none
     rope_theta: float = 10000.0
     rope_pct: float = 1.0              # partial rotary (GPT-NeoX/phi)
@@ -239,6 +240,10 @@ def _activation(cfg: TransformerConfig, gate, up):
         return jax.nn.silu(gate) * up
     if cfg.activation == "gelu_gated":
         return jax.nn.gelu(gate) * up
+    if cfg.activation == "relu":
+        return jax.nn.relu(up)
+    if cfg.activation == "gelu_exact":  # HF "gelu" = erf, not tanh approx
+        return jax.nn.gelu(up, approximate=False)
     return jax.nn.gelu(up)
 
 
@@ -542,6 +547,8 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
         logits = jnp.einsum("bse,ve->bsv", x, params["embed"]["tokens"].astype(cfg.dtype))
     else:
         logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(cfg.dtype))
+    if "lm_head_bias" in params:  # phi family ships an lm_head bias
+        logits = logits + params["lm_head_bias"].astype(cfg.dtype)
     logits = _constrain(logits, BATCH, "seq", "tensor")
     logits = logits.astype(jnp.float32)
     if return_aux:

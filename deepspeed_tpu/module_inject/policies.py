@@ -107,7 +107,66 @@ class GPT2Policy(InjectionPolicy):
         return hf_ckpt.load_gpt2(state_dict, cfg, dtype=dtype)
 
 
-_POLICIES = [LlamaPolicy, Qwen2Policy, MixtralPolicy, GPTNeoXPolicy, GPT2Policy]
+class FalconPolicy(InjectionPolicy):
+    """Falcon 7b/40b/falcon2 (reference v2 model_implementations/falcon
+    + containers/falcon): MQA/GQA fused-QKV, parallel attn+mlp."""
+    MODEL_TYPES = ("falcon", "refinedweb", "refinedwebmodel")
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.falcon_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        # (num_heads, kv_heads) in cfg fully determine the fused-QKV
+        # grouping — no HF arch flags needed, load stays stateless
+        return hf_ckpt.load_falcon(state_dict, cfg, dtype=dtype)
+
+
+class OPTPolicy(InjectionPolicy):
+    """OPT (reference v2 model_implementations/opt + containers/opt.py):
+    learned positions, relu MLP, biases everywhere."""
+    MODEL_TYPES = ("opt",)
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.opt_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_opt(state_dict, cfg, dtype=dtype)
+
+
+class PhiPolicy(InjectionPolicy):
+    """Phi-1/1.5/2 (reference v2 model_implementations/phi): parallel
+    residual off one LN, partial rotary, lm_head bias."""
+    MODEL_TYPES = ("phi",)
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.phi_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_phi(state_dict, cfg, dtype=dtype)
+
+
+class Phi3Policy(InjectionPolicy):
+    """Phi-3 (reference v2 model_implementations/phi3): llama-shaped
+    with fused qkv/gate_up projections."""
+    MODEL_TYPES = ("phi3",)
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.phi3_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_phi3(state_dict, cfg, dtype=dtype)
+
+
+_POLICIES = [LlamaPolicy, Qwen2Policy, MixtralPolicy, GPTNeoXPolicy,
+             GPT2Policy, FalconPolicy, OPTPolicy, PhiPolicy, Phi3Policy]
 
 
 def replace_policy_for(model_type: str) -> InjectionPolicy:

@@ -41,6 +41,9 @@ _FORMATS = {
     "fp4_e2m1": (jnp.float4_e2m1fn, 6.0, (2, 1)),
 }
 
+#: formats quantize_channelwise/quantize accept (int8 is handled inline)
+SUPPORTED_FORMATS = ("int8",) + tuple(_FORMATS)
+
 # reference FP_Quantize keys formats by q_bits (quantize.py:46)
 _BITS_TO_FORMAT = {8: "fp8_e4m3", 6: "fp6_e3m2", 12: "fp8_e4m3",
                    4: "fp4_e2m1"}

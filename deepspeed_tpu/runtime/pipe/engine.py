@@ -517,9 +517,13 @@ class PipelinedModule:
 
             total = gpipe_spmd(self.mesh, self.num_stages, stage_fn,
                                params, x, consts=(y,), last_fn=last_fn)
-            # loss_fn returns a per-micro-batch mean; micro-batches are
-            # equally sized on this path, so the flat mean is the mean
-            # of means
+            # Micro-batch average, matching the reference pipeline
+            # engine (its total_loss accumulates per-micro-batch losses
+            # and divides by micro_batches).  CONTRACT: loss_fn must
+            # return a per-micro-batch MEAN for this to equal the flat
+            # batch mean; a sum-style or unevenly-masked loss_fn gets
+            # the reference's mean-of-means semantics, not the flat
+            # mean — use schedule="gpipe" for exact flat-batch loss.
             return total / M
 
         outputs = gpipe_spmd(self.mesh, self.num_stages, stage_fn,

@@ -277,3 +277,145 @@ class TestHFImportBreadth:
         # dense scoring path must route the MoE mlp too
         logits = eng.forward([[1, 5, 9, 2]])
         assert np.asarray(logits).shape == (1, 4, 128)
+
+
+def _tiny_hf_falcon(new_arch=False):
+    import transformers
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, new_decoder_architecture=new_arch,
+        multi_query=not new_arch, num_kv_heads=2 if new_arch else None,
+        parallel_attn=True, bias=False, alibi=False,
+        max_position_embeddings=128, layer_norm_epsilon=1e-5)
+    import torch
+    torch.manual_seed(0)
+    return transformers.FalconForCausalLM(cfg)
+
+
+def _tiny_hf_opt():
+    import transformers
+    cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        activation_function="relu", do_layer_norm_before=True,
+        word_embed_proj_dim=64)
+    import torch
+    torch.manual_seed(0)
+    return transformers.OPTForCausalLM(cfg)
+
+
+def _tiny_hf_phi():
+    import transformers
+    cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        partial_rotary_factor=0.5, max_position_embeddings=128,
+        layer_norm_eps=1e-5)
+    import torch
+    torch.manual_seed(0)
+    return transformers.PhiForCausalLM(cfg)
+
+
+def _tiny_hf_phi3():
+    import transformers
+    cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, pad_token_id=0)
+    import torch
+    torch.manual_seed(0)
+    return transformers.Phi3ForCausalLM(cfg)
+
+
+class TestHFImportBreadthFalconOptPhi:
+    """Completes reference v2 model_implementations coverage: falcon
+    (both fused-QKV variants), opt, phi, phi3."""
+
+    @pytest.mark.parametrize("new_arch", [False, True],
+                             ids=["falcon7b-mqa", "falcon-new-gqa"])
+    def test_falcon_logits_parity(self, new_arch):
+        import torch
+        hf = _tiny_hf_falcon(new_arch).eval()
+        cfg, params = from_pretrained(hf, dtype=jnp.float32)
+        assert cfg.parallel_residual
+        assert cfg.kv_heads == (2 if new_arch else 1)
+        ids = np.arange(1, 21, dtype=np.int32)[None, :] % 128
+        with torch.no_grad():
+            ref = hf(torch.tensor(np.asarray(ids), dtype=torch.long)
+                     ).logits.numpy()
+        cfg_f32 = dataclasses.replace(cfg, dtype=jnp.float32)
+        ours = np.asarray(forward(cfg_f32, params, ids))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    def test_opt_logits_parity(self):
+        import torch
+        hf = _tiny_hf_opt().eval()
+        cfg, params = from_pretrained(hf, dtype=jnp.float32)
+        assert cfg.activation == "relu" and cfg.pos_emb == "learned"
+        ids = np.arange(1, 17, dtype=np.int32)[None, :] % 128
+        with torch.no_grad():
+            ref = hf(torch.tensor(np.asarray(ids), dtype=torch.long)
+                     ).logits.numpy()
+        cfg_f32 = dataclasses.replace(cfg, dtype=jnp.float32)
+        ours = np.asarray(forward(cfg_f32, params, ids))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    def test_phi_logits_parity(self):
+        import torch
+        hf = _tiny_hf_phi().eval()
+        cfg, params = from_pretrained(hf, dtype=jnp.float32)
+        assert cfg.parallel_residual and cfg.rope_pct == 0.5
+        assert "lm_head_bias" in params
+        ids = np.arange(1, 21, dtype=np.int32)[None, :] % 128
+        with torch.no_grad():
+            ref = hf(torch.tensor(np.asarray(ids), dtype=torch.long)
+                     ).logits.numpy()
+        cfg_f32 = dataclasses.replace(cfg, dtype=jnp.float32)
+        ours = np.asarray(forward(cfg_f32, params, ids))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    def test_phi3_logits_parity(self):
+        import torch
+        hf = _tiny_hf_phi3().eval()
+        cfg, params = from_pretrained(hf, dtype=jnp.float32)
+        ids = np.arange(1, 21, dtype=np.int32)[None, :] % 128
+        with torch.no_grad():
+            ref = hf(torch.tensor(np.asarray(ids), dtype=torch.long)
+                     ).logits.numpy()
+        cfg_f32 = dataclasses.replace(cfg, dtype=jnp.float32)
+        ours = np.asarray(forward(cfg_f32, params, ids))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize("factory", [_tiny_hf_falcon, _tiny_hf_opt,
+                                         _tiny_hf_phi, _tiny_hf_phi3])
+    def test_generate_smoke(self, factory):
+        from deepspeed_tpu.inference.v2 import (build_hf_engine, generate,
+                                                SamplingParams)
+        hf = factory().eval()
+        eng = build_hf_engine(hf, dtype=jnp.float32)
+        outs = generate(eng, [[1, 5, 9, 2]], SamplingParams(max_new_tokens=3))
+        assert len(outs[0]) == 3
+        assert all(0 <= t < 128 for t in outs[0])
+
+
+    def test_phi_v2_engine_applies_lm_head_bias(self):
+        """Regression: the v2 ragged engine must add phi's lm_head bias —
+        greedy tokens through build_hf_engine agree with HF greedy."""
+        import torch
+        from deepspeed_tpu.inference.v2 import (build_hf_engine, generate,
+                                                SamplingParams)
+        hf = _tiny_hf_phi().eval()
+        with torch.no_grad():  # bias large enough to flip the argmax
+            hf.lm_head.bias.add_(torch.randn_like(hf.lm_head.bias) * 2.0)
+        prompt = [3, 7, 11, 2]
+        eng = build_hf_engine(hf, dtype=jnp.float32)
+        ours = generate(eng, [prompt], SamplingParams(max_new_tokens=3,
+                                                      temperature=0.0))[0]
+        ids = torch.tensor([prompt])
+        ref = []
+        with torch.no_grad():
+            for _ in range(3):
+                nxt = hf(ids).logits[0, -1].argmax().item()
+                ref.append(nxt)
+                ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
+        assert ours == ref, (ours, ref)

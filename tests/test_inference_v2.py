@@ -496,3 +496,32 @@ class TestQuantizedInference:
         with pytest.raises(ValueError):
             eng._model.quantize_weights("int8")
         eng._model.quantize_weights("fp8_e4m3")  # same fmt: no-op
+
+    def test_unknown_format_rejected_without_poisoning(self):
+        """Regression: a typo'd fmt must raise ValueError and leave the
+        model un-quantized so the corrected call succeeds."""
+        eng = self._engine()
+        with pytest.raises(ValueError, match="fp8"):
+            eng._model.quantize_weights("fp8")  # typo for fp8_e4m3
+        eng._model.quantize_weights("fp8_e4m3")  # recovers cleanly
+        assert isinstance(eng._model.params["layers"]["attn"]["wq"], dict)
+
+    def test_moe_experts_get_per_expert_scales(self):
+        """Regression: stacked-expert mlp weights [L, experts, in, out]
+        must not share one absmax across experts."""
+        from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                                RaggedInferenceEngineConfig,
+                                                RaggedInferenceModel)
+        from deepspeed_tpu.models.mixtral import MixtralForCausalLM
+        import dataclasses
+        model = MixtralForCausalLM("debug", num_experts=2, top_k=1,
+                                   dtype=jnp.float32)
+        cfg = dataclasses.replace(model.cfg, moe_num_experts=2, moe_top_k=1)
+        params = meta.unbox(model.init_params(jax.random.key(0)))
+        ecfg = RaggedInferenceEngineConfig.from_dict(
+            {"quantization": {"enabled": True, "fmt": "fp8_e4m3"}})
+        ecfg.kv_cache.num_pages = 64
+        eng = InferenceEngineV2(RaggedInferenceModel(cfg, params), ecfg)
+        wi = eng._model.params["layers"]["mlp"]["wi"]  # [L, E, in, out]
+        L, E = wi["q"].shape[:2]
+        assert wi["scale"].shape[:2] == (L, E), wi["scale"].shape
