@@ -649,6 +649,138 @@ def load_phi3(state_dict: Dict[str, Any], cfg: TransformerConfig,
     return load_llama(out, cfg, dtype)
 
 
+def bloom_config_from_hf(hf_cfg) -> TransformerConfig:
+    """BLOOM (reference ``module_inject/containers/bloom.py``): ALiBi
+    positions, post-embedding layernorm, per-head-interleaved fused QKV,
+    biases everywhere, tied embeddings."""
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=4 * hf_cfg.hidden_size,
+        num_layers=hf_cfg.n_layer,
+        num_heads=hf_cfg.n_head, num_kv_heads=hf_cfg.n_head,
+        max_seq_len=getattr(hf_cfg, "seq_length", 2048),
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_epsilon,
+        activation="gelu", pos_emb="alibi", embed_layernorm=True,
+        tie_embeddings=True, use_bias=True, dtype=jnp.bfloat16)
+
+
+def load_bloom(state_dict: Dict[str, Any], cfg: TransformerConfig,
+               dtype=jnp.float32) -> Dict[str, Any]:
+    """HF BLOOM state dict -> param tree.  ``query_key_value`` packs
+    [q, k, v] per head along the output dim (ALiBi, so no rope
+    re-laning)."""
+    sd = {k.removeprefix("transformer."): _np(v)
+          for k, v in state_dict.items()}
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.dims_per_head
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        w = sd[p + "self_attention.query_key_value.weight"].reshape(
+            H, 3, D, E)
+        b = sd[p + "self_attention.query_key_value.bias"].reshape(H, 3, D)
+        layers.append({
+            "attn": {
+                "wq": w[:, 0].reshape(H * D, E).T.reshape(E, H, D),
+                "wk": w[:, 1].reshape(H * D, E).T.reshape(E, H, D),
+                "wv": w[:, 2].reshape(H * D, E).T.reshape(E, H, D),
+                "wo": sd[p + "self_attention.dense.weight"].T.reshape(H, D, E),
+                "bq": b[:, 0], "bk": b[:, 1], "bv": b[:, 2],
+                "bo": sd[p + "self_attention.dense.bias"],
+            },
+            "mlp": {
+                "wi": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                "bi": sd[p + "mlp.dense_h_to_4h.bias"],
+                "wo": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                "bo": sd[p + "mlp.dense_4h_to_h.bias"],
+            },
+            "norm1": {"scale": sd[p + "input_layernorm.weight"],
+                      "bias": sd[p + "input_layernorm.bias"]},
+            "norm2": {"scale": sd[p + "post_attention_layernorm.weight"],
+                      "bias": sd[p + "post_attention_layernorm.bias"]},
+        })
+    params = {
+        "embed": {
+            "tokens": sd["word_embeddings.weight"],
+            "norm": {"scale": sd["word_embeddings_layernorm.weight"],
+                     "bias": sd["word_embeddings_layernorm.bias"]},
+        },
+        "layers": _stack(layers) if cfg.scan_layers
+        else {f"layer_{i}": l for i, l in enumerate(layers)},
+        "final_norm": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+    return _cast(params, dtype)
+
+
+def gptj_config_from_hf(hf_cfg) -> TransformerConfig:
+    """GPT-J (reference ``module_inject/containers/gptj.py``): parallel
+    attn+mlp off ONE ln, partial interleaved rotary (native convention —
+    no re-laning), bias-free attention but biased MLP and lm_head."""
+    D = hf_cfg.n_embd // hf_cfg.n_head
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.n_embd,
+        intermediate_size=getattr(hf_cfg, "n_inner", None)
+        or 4 * hf_cfg.n_embd,
+        num_layers=hf_cfg.n_layer,
+        num_heads=hf_cfg.n_head, num_kv_heads=hf_cfg.n_head,
+        max_seq_len=hf_cfg.n_positions,
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_epsilon,
+        activation=_map_hf_act(getattr(hf_cfg, "activation_function",
+                                       "gelu_new")),
+        pos_emb="rope",
+        rope_pct=(hf_cfg.rotary_dim or D) / D,
+        parallel_residual=True,
+        tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        use_bias=True, dtype=jnp.bfloat16)
+
+
+def load_gptj(state_dict: Dict[str, Any], cfg: TransformerConfig,
+              dtype=jnp.float32) -> Dict[str, Any]:
+    """HF GPT-J state dict -> param tree.  GPT-J rotates interleaved
+    pairs natively (rotate_every_two) — our convention, no re-laning.
+    Attention projections carry no biases; the core's use_bias=True
+    (needed for the MLP/lm_head biases) gets exact zero attn biases."""
+    sd = {k.removeprefix("transformer."): _np(v)
+          for k, v in state_dict.items()}
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.dims_per_head
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        ln = {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]}
+        layers.append({
+            "attn": {
+                "wq": sd[p + "attn.q_proj.weight"].T.reshape(E, H, D),
+                "wk": sd[p + "attn.k_proj.weight"].T.reshape(E, H, D),
+                "wv": sd[p + "attn.v_proj.weight"].T.reshape(E, H, D),
+                "wo": sd[p + "attn.out_proj.weight"].T.reshape(H, D, E),
+                "bq": np.zeros((H, D), np.float32),
+                "bk": np.zeros((H, D), np.float32),
+                "bv": np.zeros((H, D), np.float32),
+                "bo": np.zeros((E,), np.float32),
+            },
+            "mlp": {
+                "wi": sd[p + "mlp.fc_in.weight"].T,
+                "bi": sd[p + "mlp.fc_in.bias"],
+                "wo": sd[p + "mlp.fc_out.weight"].T,
+                "bo": sd[p + "mlp.fc_out.bias"],
+            },
+            "norm1": ln, "norm2": dict(ln),
+        })
+    params = {
+        "embed": {"tokens": sd["wte.weight"]},
+        "layers": _stack(layers) if cfg.scan_layers
+        else {f"layer_{i}": l for i, l in enumerate(layers)},
+        "final_norm": {"scale": sd["ln_f.weight"],
+                       "bias": sd["ln_f.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"].T
+        if "lm_head.bias" in sd:
+            params["lm_head_bias"] = sd["lm_head.bias"]
+    return _cast(params, dtype)
+
+
 def load_hf_model(model_or_path):
     """Normalize a path-or-instance to a transformers model instance —
     the single place checkpoint-loading policy lives."""

@@ -187,6 +187,8 @@ class RaggedInferenceModel:
         if cfg.pos_emb == "learned":
             safe = jnp.minimum(pos, cfg.max_seq_len - 1)
             x = x + params["embed"]["positions"].astype(cfg.dtype)[safe]
+        if cfg.embed_layernorm:  # BLOOM word_embeddings_layernorm
+            x = self._norm(params["embed"]["norm"], x)
         sin, cos = (T.rope_table(cfg, pos) if cfg.pos_emb == "rope"
                     else (None, None))
 

@@ -98,10 +98,11 @@ def _pallas_decode(cfg):
     """Q=1 decode via the Pallas paged kernel; prefill via the jnp path
     (paged_attention auto-splits on Q)."""
     from ...ops.paged_attention import paged_attention
+    slopes = _alibi_for(cfg)
 
     def attn(q, kv_layer, page_table, start_pos, q_lens):
         return paged_attention(q, kv_layer, page_table, start_pos, q_lens,
-                               use_kernel=None)
+                               use_kernel=None, alibi_slopes=slopes)
     return attn
 
 
@@ -109,11 +110,19 @@ def _pallas_decode(cfg):
 def _dense_gather(cfg):
     """Pure-jnp paged attention (CPU / ground truth)."""
     from ...ops.paged_attention import paged_attention
+    slopes = _alibi_for(cfg)
 
     def attn(q, kv_layer, page_table, start_pos, q_lens):
         return paged_attention(q, kv_layer, page_table, start_pos, q_lens,
-                               use_kernel=False)
+                               use_kernel=False, alibi_slopes=slopes)
     return attn
+
+
+def _alibi_for(cfg):
+    if getattr(cfg, "pos_emb", None) != "alibi":
+        return None
+    from ...models.transformer import alibi_slopes
+    return alibi_slopes(cfg.num_heads)
 
 
 # norm implementations share the (params, x) -> y calling convention

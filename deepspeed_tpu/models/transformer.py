@@ -51,7 +51,9 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     # silu_gated | gelu (tanh approx) | gelu_exact | gelu_gated | relu
     activation: str = "silu_gated"
-    pos_emb: str = "rope"              # rope | learned | none
+    pos_emb: str = "rope"              # rope | learned | alibi | none
+    # layernorm over the token embeddings (BLOOM word_embeddings_layernorm)
+    embed_layernorm: bool = False
     rope_theta: float = 10000.0
     rope_pct: float = 1.0              # partial rotary (GPT-NeoX/phi)
     causal: bool = True
@@ -163,6 +165,8 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Dict[str, Any]:
     if cfg.pos_emb == "learned":
         params["embed"]["positions"] = _boxed(
             jax.random.normal(keys[2], (cfg.max_seq_len, e)) * 0.02, (None, "embed"))
+    if cfg.embed_layernorm:
+        params["embed"]["norm"] = _norm_init(cfg, e)
     if not cfg.tie_embeddings:
         params["lm_head"] = _boxed(_dense_init(keys[3], (e, v), e), ("embed", "vocab"))
     return params
@@ -343,8 +347,22 @@ def _divisible_head_axes(n: int, axes=("seq", "tensor")) -> tuple:
     return tuple(out)
 
 
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (geometric in 2^(-8/n), with the standard
+    interleave extension for non-power-of-two head counts)."""
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return [start ** (i + 1) for i in range(n)]
+    k = 2 ** int(np.floor(np.log2(n_heads)))
+    slopes = pow2(k)
+    if k < n_heads:
+        slopes += pow2(2 * k)[0::2][: n_heads - k]
+    return np.asarray(slopes, np.float32)
+
+
 def dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v,
-                          mask: Optional[jax.Array]) -> jax.Array:
+                          mask: Optional[jax.Array],
+                          attn_bias: Optional[jax.Array] = None) -> jax.Array:
     """Grouped-query attention, fp32 softmax.  q: [B,S,H,D], k/v: [B,S,K,D].
 
     Hot op #1 (reference csrc/transformer softmax/attention kernels).
@@ -368,6 +386,9 @@ def dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v,
     kv_v = _constrain(kv_v, BATCH, None, k_axes or None, None)
     scores = jnp.einsum("bskgd,btkd->bkgst", q, kv_k) / np.sqrt(dd)
     scores = scores.astype(jnp.float32)
+    if attn_bias is not None:  # ALiBi: [B,H,T] additive, per q-head
+        scores = scores + attn_bias.reshape(
+            b, k_heads, groups, 1, attn_bias.shape[-1])
     if mask is not None:
         scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     scores = _constrain(scores, BATCH, k_axes or None, g_axes or None,
@@ -379,7 +400,7 @@ def dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v,
 
 
 def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
-                     use_flash: bool = False):
+                     use_flash: bool = False, attn_bias=None):
     dtype = cfg.dtype
     wq, wk, wv, wo = (p["wq"].astype(dtype), p["wk"].astype(dtype),
                       p["wv"].astype(dtype), p["wo"].astype(dtype))
@@ -407,7 +428,7 @@ def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
     if use_flash:
         out = flash_dot_product_attention(cfg, q, k, v)
     else:
-        out = dot_product_attention(cfg, q, k, v, mask)
+        out = dot_product_attention(cfg, q, k, v, mask, attn_bias)
     out = jnp.einsum("bshd,hde->bse", out, wo)
     if cfg.use_bias:
         out = out + p["bo"].astype(dtype)
@@ -430,12 +451,13 @@ def _mlp_block(cfg: TransformerConfig, p, x):
 
 
 def _layer_body(cfg: TransformerConfig, layer_params, x, sin, cos, mask,
-                mlp_fn=None, use_flash: bool = False):
+                mlp_fn=None, use_flash: bool = False, attn_bias=None):
     """Returns (x, aux) — aux is 0 for dense MLPs, the load-balancing loss
     for MoE mlp_fns (accumulated through the layer scan)."""
     h = _norm_apply(cfg, layer_params["norm1"], x)
     attn_out = _attention_block(cfg, layer_params["attn"], h, sin, cos,
-                                mask, use_flash=use_flash)
+                                mask, use_flash=use_flash,
+                                attn_bias=attn_bias)
     if cfg.parallel_residual:
         # GPT-NeoX: mlp sees ln2(x), both branches add to the SAME input
         h2 = _norm_apply(cfg, layer_params["norm2"], x)
@@ -477,6 +499,7 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
                  and cfg.causal
                  and attention_mask is None
                  and positions is None
+                 and cfg.pos_emb != "alibi"  # kernel has no bias input
                  and s > 1
                  and _flash_ok(cfg, cfg.num_heads, cfg.kv_heads, batch=b))
     if cfg.attention_impl == "flash" and not use_flash:
@@ -500,6 +523,8 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
         x = table[input_ids]
     if cfg.pos_emb == "learned":
         x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
+    if cfg.embed_layernorm:  # BLOOM word_embeddings_layernorm
+        x = _norm_apply(cfg, params["embed"]["norm"], x)
     x = _constrain(x, BATCH, "seq", None)
 
     # mask: [B, S(q), S(k)]  (not needed on the flash path — the kernel
@@ -515,10 +540,17 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
 
     sin, cos = rope_table(cfg, positions) if cfg.pos_emb == "rope" else (None, None)
 
+    # ALiBi: additive per-head bias that depends only on the KEY position
+    # (softmax is shift-invariant along each query row, so slope*(t-s)
+    # and slope*t are equivalent under the causal mask)
+    attn_bias = None
+    if cfg.pos_emb == "alibi":
+        slopes = jnp.asarray(alibi_slopes(cfg.num_heads))
+        attn_bias = slopes[None, :, None] * positions[:, None, :].astype(
+            jnp.float32)                                      # [B,H,T]
+
     body = functools.partial(_layer_body, cfg, mlp_fn=mlp_fn,
-                             use_flash=use_flash) \
-        if mlp_fn is not None else functools.partial(_layer_body, cfg,
-                                                     use_flash=use_flash)
+                             use_flash=use_flash, attn_bias=attn_bias)
 
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:

@@ -165,8 +165,37 @@ class Phi3Policy(InjectionPolicy):
         return hf_ckpt.load_phi3(state_dict, cfg, dtype=dtype)
 
 
+class BloomPolicy(InjectionPolicy):
+    """BLOOM (reference containers/bloom.py): ALiBi + post-embedding
+    layernorm + per-head fused QKV."""
+    MODEL_TYPES = ("bloom",)
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.bloom_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_bloom(state_dict, cfg, dtype=dtype)
+
+
+class GPTJPolicy(InjectionPolicy):
+    """GPT-J (reference containers/gptj.py): parallel residual off one
+    ln, native interleaved partial rotary."""
+    MODEL_TYPES = ("gptj",)
+
+    @classmethod
+    def config_from_hf(cls, hf_cfg):
+        return hf_ckpt.gptj_config_from_hf(hf_cfg)
+
+    @classmethod
+    def load(cls, state_dict, cfg, dtype):
+        return hf_ckpt.load_gptj(state_dict, cfg, dtype=dtype)
+
+
 _POLICIES = [LlamaPolicy, Qwen2Policy, MixtralPolicy, GPTNeoXPolicy,
-             GPT2Policy, FalconPolicy, OPTPolicy, PhiPolicy, Phi3Policy]
+             GPT2Policy, FalconPolicy, OPTPolicy, PhiPolicy, Phi3Policy,
+             BloomPolicy, GPTJPolicy]
 
 
 def replace_policy_for(model_type: str) -> InjectionPolicy:

@@ -86,6 +86,37 @@ class WandbMonitor(Monitor):
             self._wandb.log({tag: value}, step=int(step))
 
 
+class CometMonitor(Monitor):
+    """Comet experiment writer (reference monitor/comet.py CometMonitor);
+    gated import — comet_ml is not in the image, so this degrades to
+    disabled with a warning rather than failing."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._exp = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                import comet_ml
+                self._exp = comet_ml.Experiment(
+                    project_name=config.project or "deepspeed_tpu",
+                    workspace=config.team or None)
+                if config.job_name:
+                    self._exp.set_name(config.job_name)
+            except Exception as e:
+                logger.warning("comet_ml unavailable: %s", e)
+                self.enabled = False
+
+    @property
+    def experiment(self):
+        return self._exp
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self._exp is None:
+            return
+        for tag, value, step in event_list:
+            self._exp.log_metric(tag, value, step=int(step))
+
+
 class MonitorMaster(Monitor):
     """Fan-out master (reference monitor/monitor.py:30)."""
 
@@ -97,6 +128,8 @@ class MonitorMaster(Monitor):
             self.monitors.append(CSVMonitor(ds_config.csv_monitor))
         if ds_config.wandb.enabled:
             self.monitors.append(WandbMonitor(ds_config.wandb))
+        if ds_config.comet.enabled:
+            self.monitors.append(CometMonitor(ds_config.comet))
         self.enabled = any(m.enabled for m in self.monitors)
 
     def write_events(self, event_list: List[Event]) -> None:

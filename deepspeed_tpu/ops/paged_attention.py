@@ -70,6 +70,7 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
                     q_lens: jax.Array, *,
                     sm_scale: float | None = None,
                     use_kernel: Optional[bool] = None,
+                    alibi_slopes: Optional[jax.Array] = None,
                     interpret: bool = False) -> jax.Array:
     """Masked GQA attention of [S, Q] new tokens over their paged context.
 
@@ -90,7 +91,8 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
         if use_kernel:
             return paged_decode_attention(
                 q, kv_layer, page_table, start_pos,
-                sm_scale=sm_scale, interpret=interpret)
+                sm_scale=sm_scale, alibi_slopes=alibi_slopes,
+                interpret=interpret)
     page_size = kv_layer.shape[1]
     K = kv_layer.shape[3]
     G = H // K
@@ -107,6 +109,13 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
 
     pos = token_positions(start_pos, Q)                     # [S, Q]
     ctx = jnp.arange(C, dtype=jnp.int32)
+    if alibi_slopes is not None:
+        # ALiBi: per-q-head bias linear in the absolute key position
+        # (context row c IS position c — pages fill in order); head
+        # h = k*G + g matches the grouped reshape above
+        sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(K, G)
+        scores = scores + (sl[None, :, :, None, None]
+                           * ctx[None, None, None, None, :])
     # context element c visible to query (s, i) iff c <= pos[s, i]; the
     # page gather places context position c at row c of the flattened
     # pages exactly (pages are filled in order).
@@ -123,17 +132,24 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
 # Pallas decode kernel (Q = 1)
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(pt_ref, sp_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, page_size, num_pages_per_seq,
-                   sm_scale):
+def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
+                   sm_scale, has_alibi):
     """One (slot, kv_head, page) grid step of flash-style decode.
 
     q_ref : [G, D]         (this slot's queries for one kv head)
     k_ref/v_ref : [page_size, D]  (one cache page, DMA'd via the page
                             table — see the index maps in the caller)
+    slopes_ref : [1, G]    per-q-head ALiBi slopes — present ONLY when
+                            ``has_alibi`` (the kernel is specialized
+                            statically so non-ALiBi models pay nothing)
     Scratch m/l/acc carry the running max / denominator / weighted sum
     across the page axis (the innermost, sequential grid dim).
     """
+    if has_alibi:
+        slopes_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        slopes_ref = None
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
     s = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -155,6 +171,9 @@ def _decode_kernel(pt_ref, sp_ref, q_ref, k_ref, v_ref, o_ref,
             preferred_element_type=jnp.float32) * sm_scale  # [G, page]
         ctx = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
+        if has_alibi:  # additive bias linear in the absolute key position
+            scores = scores + (slopes_ref[0, :][:, None]
+                               * ctx.astype(jnp.float32))
         scores = jnp.where(ctx < ctx_len, scores, MASK_VALUE)
         m_prev = m_scr[:]                              # [G, 1]
         l_prev = l_scr[:]
@@ -176,6 +195,7 @@ def _decode_kernel(pt_ref, sp_ref, q_ref, k_ref, v_ref, o_ref,
 def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
                            page_table: jax.Array, start_pos: jax.Array, *,
                            sm_scale: float | None = None,
+                           alibi_slopes: Optional[jax.Array] = None,
                            interpret: bool = False) -> jax.Array:
     """Pallas decode attention: Q=1 queries over paged KV.
 
@@ -196,6 +216,7 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
 
     qg = q.reshape(S, K, G, D)  # fold GQA: per kv head, G queries
+    has_alibi = alibi_slopes is not None
 
     grid = (S, K, P_pages)
     # index maps receive (s, k, p, *scalar_prefetch_refs)
@@ -206,15 +227,24 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
                           lambda s, k, p, pt, sp: (pt[s, p], 0, 1, k, 0))
     o_spec = pl.BlockSpec((None, None, G, D), lambda s, k, p, pt, sp: (s, k, 0, 0))
 
+    in_specs = [q_spec, k_spec, v_spec]
+    inputs = (qg, kv_layer, kv_layer)
+    if has_alibi:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(K, 1, G)
+        sl_spec = pl.BlockSpec((None, 1, G),
+                               lambda s, k, p, pt, sp: (k, 0, 0))
+        in_specs = [sl_spec] + in_specs
+        inputs = (slopes,) + inputs
+
     kernel = functools.partial(
         _decode_kernel, page_size=page_size, num_pages_per_seq=P_pages,
-        sm_scale=scale)
+        sm_scale=scale, has_alibi=has_alibi)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[q_spec, k_spec, v_spec],
+            in_specs=in_specs,
             out_specs=o_spec,
             scratch_shapes=[
                 pltpu.VMEM((G, 1), jnp.float32),
@@ -226,8 +256,7 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), start_pos.astype(jnp.int32),
-      qg, kv_layer, kv_layer)
+    )(page_table.astype(jnp.int32), start_pos.astype(jnp.int32), *inputs)
     return out.reshape(S, Q, H, D)
 
 

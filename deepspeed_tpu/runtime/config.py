@@ -319,6 +319,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     tensorboard: MonitorConfigItem = Field(default_factory=MonitorConfigItem)
     wandb: MonitorConfigItem = Field(default_factory=MonitorConfigItem)
     csv_monitor: MonitorConfigItem = Field(default_factory=MonitorConfigItem)
+    comet: MonitorConfigItem = Field(default_factory=MonitorConfigItem)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)

@@ -332,6 +332,14 @@ class PipelinedCausalLM:
         sin, cos = tfm.rope_table(cfg, positions) if cfg.pos_emb == "rope" \
             else (jnp.zeros((M, b, s, 1)), jnp.zeros((M, b, s, 1)))
 
+        # ALiBi: [M, b, H, s] per-micro-batch additive bias (key-position
+        # linear; see models/transformer.forward); None otherwise
+        abias_all = None
+        if cfg.pos_emb == "alibi":
+            slopes = jnp.asarray(tfm.alibi_slopes(cfg.num_heads))
+            abias_all = (slopes[None, None, :, None]
+                         * positions[:, :, None, :].astype(jnp.float32))
+
         labels_all = batch.get("labels")
         if labels_all is not None:
             labels_all = labels_all.reshape(M, b, s)
@@ -341,9 +349,13 @@ class PipelinedCausalLM:
                 lambda c: jax.lax.dynamic_index_in_dim(c, mb_id, 0,
                                                        keepdims=False),
                 consts[:3])
+            ab = (jax.lax.dynamic_index_in_dim(consts[3], mb_id, 0,
+                                               keepdims=False)
+                  if cfg.pos_emb == "alibi" else None)
 
             def layer(carry, lp):
-                y, _ = tfm._layer_body(cfg, lp, carry, sin, cos, mask)
+                y, _ = tfm._layer_body(cfg, lp, carry, sin, cos, mask,
+                                       attn_bias=ab)
                 return y, None
             out, _ = jax.lax.scan(layer, act, stage_layers)
             return out
@@ -360,7 +372,7 @@ class PipelinedCausalLM:
                 logits = jnp.einsum(
                     "bse,ev->bsv", h, edge["lm_head"].astype(cfg.dtype))
             logits = logits.astype(jnp.float32)
-            _, _, _, c_ids, c_labels, c_am, _ = consts
+            _, _, _, _, c_ids, c_labels, c_am, _ = consts
             am = (jax.lax.dynamic_index_in_dim(c_am, mb_id, 0,
                                                keepdims=False)
                   if c_am is not None else None)
@@ -392,8 +404,10 @@ class PipelinedCausalLM:
             x = edge["embed"]["tokens"].astype(cfg.dtype)[ids_mb]
             if cfg.pos_emb == "learned":
                 pos_mb = jax.lax.dynamic_index_in_dim(
-                    consts[6], mb_id, 0, keepdims=False)
+                    consts[7], mb_id, 0, keepdims=False)
                 x = x + edge["embed"]["positions"].astype(cfg.dtype)[pos_mb]
+            if cfg.embed_layernorm:  # BLOOM word_embeddings_layernorm
+                x = tfm._norm_apply(cfg, edge["embed"]["norm"], x)
             return x
 
         if self.schedule == "1f1b":
@@ -403,9 +417,12 @@ class PipelinedCausalLM:
                 edge["lm_head"] = params["lm_head"]
             am_c = (attn_mask.reshape(M, b, s)
                     if attn_mask is not None else None)
+            abias_c = (abias_all if abias_all is not None
+                       else jnp.zeros((M, 1), jnp.float32))  # never indexed
             loss_sum, count = gpipe_spmd(
                 self.mesh, self.num_stages, stage_fn, params["layers"], ids,
-                consts=(sin, cos, mask, ids, labels_all, am_c, positions),
+                consts=(sin, cos, mask, abias_c, ids, labels_all, am_c,
+                        positions),
                 remat=cfg.remat,
                 first_fn=embed_mb, last_fn=head_and_ce, edge_params=edge)
             return loss_sum / jnp.maximum(count, 1.0)
@@ -414,9 +431,13 @@ class PipelinedCausalLM:
         x = params["embed"]["tokens"].astype(cfg.dtype)[ids]
         if cfg.pos_emb == "learned":
             x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
+        if cfg.embed_layernorm:
+            x = tfm._norm_apply(cfg, params["embed"]["norm"], x)
         outputs = gpipe_spmd(self.mesh, self.num_stages, stage_fn,
                              params["layers"], x,
-                             consts=(sin, cos, mask),
+                             consts=(sin, cos, mask,
+                                     abias_all if abias_all is not None
+                                     else jnp.zeros((M, 1), jnp.float32)),
                              remat=cfg.remat)   # [M,b,s,e]
         h = tfm._norm_apply(cfg, params["final_norm"],
                             outputs.reshape(M * b, s, -1))

@@ -419,3 +419,86 @@ class TestHFImportBreadthFalconOptPhi:
                 ref.append(nxt)
                 ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
         assert ours == ref, (ours, ref)
+
+
+def _tiny_hf_bloom():
+    import transformers
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5)
+    import torch
+    torch.manual_seed(0)
+    return transformers.BloomForCausalLM(cfg)
+
+
+def _tiny_hf_gptj():
+    import transformers
+    cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+        rotary_dim=8, n_inner=None)
+    import torch
+    torch.manual_seed(0)
+    return transformers.GPTJForCausalLM(cfg)
+
+
+class TestHFImportBloomGPTJ:
+    """ALiBi (bloom) + native-interleaved partial rotary (gptj) — the
+    remaining reference module_inject container families."""
+
+    def test_bloom_logits_parity(self):
+        import torch
+        hf = _tiny_hf_bloom().eval()
+        cfg, params = from_pretrained(hf, dtype=jnp.float32)
+        assert cfg.pos_emb == "alibi" and cfg.embed_layernorm
+        ids = np.arange(1, 21, dtype=np.int32)[None, :] % 128
+        with torch.no_grad():
+            ref = hf(torch.tensor(np.asarray(ids), dtype=torch.long)
+                     ).logits.numpy()
+        cfg_f32 = dataclasses.replace(cfg, dtype=jnp.float32)
+        ours = np.asarray(forward(cfg_f32, params, ids))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    def test_gptj_logits_parity(self):
+        import torch
+        hf = _tiny_hf_gptj().eval()
+        cfg, params = from_pretrained(hf, dtype=jnp.float32)
+        assert cfg.parallel_residual and cfg.rope_pct == 0.5
+        ids = np.arange(1, 21, dtype=np.int32)[None, :] % 128
+        with torch.no_grad():
+            ref = hf(torch.tensor(np.asarray(ids), dtype=torch.long)
+                     ).logits.numpy()
+        cfg_f32 = dataclasses.replace(cfg, dtype=jnp.float32)
+        ours = np.asarray(forward(cfg_f32, params, ids))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize("factory", [_tiny_hf_bloom, _tiny_hf_gptj])
+    def test_generate_smoke(self, factory):
+        """bloom exercises the alibi paged-attention path (prefill AND
+        Q=1 decode) through the v2 ragged engine."""
+        from deepspeed_tpu.inference.v2 import (build_hf_engine, generate,
+                                                SamplingParams)
+        hf = factory().eval()
+        eng = build_hf_engine(hf, dtype=jnp.float32)
+        outs = generate(eng, [[1, 5, 9, 2]], SamplingParams(max_new_tokens=3))
+        assert len(outs[0]) == 3
+        assert all(0 <= t < 128 for t in outs[0])
+
+    def test_bloom_v2_greedy_matches_hf(self):
+        """ALiBi correctness through the paged KV path: greedy tokens
+        from the ragged engine agree with HF greedy continuation."""
+        import torch
+        from deepspeed_tpu.inference.v2 import (build_hf_engine, generate,
+                                                SamplingParams)
+        hf = _tiny_hf_bloom().eval()
+        prompt = [3, 7, 11, 2, 9]
+        eng = build_hf_engine(hf, dtype=jnp.float32)
+        ours = generate(eng, [prompt], SamplingParams(max_new_tokens=3,
+                                                      temperature=0.0))[0]
+        ids = torch.tensor([prompt])
+        ref = []
+        with torch.no_grad():
+            for _ in range(3):
+                nxt = hf(ids).logits[0, -1].argmax().item()
+                ref.append(nxt)
+                ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
+        assert ours == ref, (ours, ref)

@@ -126,6 +126,22 @@ class TestPagedAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_pallas_decode_kernel_alibi_matches_jnp(self):
+        """ALiBi bias agrees between the Pallas kernel (interpret) and
+        the jnp gather path (the bloom decode hot path)."""
+        from deepspeed_tpu.models.transformer import alibi_slopes
+        (q, k_new, v_new, kv, table, start, q_lens,
+         _, _, _) = self._setup(Q=1, D=128, hist=(5, 0, 11))
+        H = q.shape[2]
+        slopes = alibi_slopes(H)
+        kv = pa.write_kv(kv, k_new, v_new, table, start, q_lens)
+        ref = pa.paged_attention(q, kv, table, start, q_lens,
+                                 use_kernel=False, alibi_slopes=slopes)
+        out = pa.paged_decode_attention(q, kv, table, start,
+                                        alibi_slopes=slopes, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_pallas_decode_kernel_gqa_groups(self):
         (q, k_new, v_new, kv, table, start, q_lens,
          _, _, _) = self._setup(S=4, Q=1, K=2, G=4, D=128,

@@ -1,0 +1,36 @@
+"""Monitor fan-out (reference monitor/monitor.py MonitorMaster +
+tensorboard/wandb/csv/comet writers)."""
+
+import os
+
+from deepspeed_tpu.monitor.monitor import (CometMonitor, CSVMonitor,
+                                           MonitorMaster)
+from deepspeed_tpu.runtime.config import load_config
+
+
+def test_csv_monitor_writes_events(tmp_path):
+    cfg = load_config({"csv_monitor": {"enabled": True,
+                                       "output_path": str(tmp_path)}})
+    master = MonitorMaster(cfg)
+    assert master.enabled
+    master.write_events([("train/loss", 1.5, 0), ("train/loss", 1.25, 1)])
+    files = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path)
+             for f in fs if f.endswith(".csv")]
+    assert files, "no csv written"
+    body = open(files[0]).read()
+    assert "1.5" in body and "1.25" in body
+
+
+def test_comet_monitor_degrades_without_comet_ml():
+    """comet_ml is not installed in the image: the writer must disable
+    itself with a warning, and the master must keep the other writers."""
+    cfg = load_config({"comet": {"enabled": True}})
+    mon = CometMonitor(cfg.comet)
+    assert mon.enabled is False and mon.experiment is None
+    mon.write_events([("x", 1.0, 0)])  # no-op, no crash
+
+    cfg2 = load_config({"comet": {"enabled": True},
+                        "csv_monitor": {"enabled": True,
+                                        "output_path": "/tmp/ds_mon"}})
+    master = MonitorMaster(cfg2)
+    assert master.enabled  # csv survives comet degradation

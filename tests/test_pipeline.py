@@ -345,3 +345,30 @@ def test_pipeline_1f1b_matches_gpipe_loss():
             0, 128, size=(eng.train_batch_size(), 32)).astype(np.int32)}
         losses[schedule] = [eng.train_batch(batch) for _ in range(3)]
     np.testing.assert_allclose(losses["1f1b"], losses["gpipe"], rtol=2e-3)
+
+
+def test_pipeline_engine_matches_dense_alibi():
+    """BLOOM-style features (ALiBi + post-embedding norm) through the
+    pipeline == dense forward loss on the same params (regression: the
+    pipeline embed/stage path silently ignored both)."""
+    import dataclasses
+    model = _tiny_llama()
+    model.cfg = dataclasses.replace(model.cfg, pos_emb="alibi",
+                                    embed_layernorm=True)
+    cfg = dict(CFG)
+    cfg["train_batch_size"] = 16
+    cfg["tpu"] = {"mesh": {"pipe": 2, "data": 4}}
+    eng = PipelineEngine(model=model, config=cfg)
+
+    batch = _batch(M=4, b=4, s=16, vocab=model.cfg.vocab_size)
+    flat_ids = batch["input_ids"].reshape(16, 16)
+
+    stages_params = jax.device_get(eng.state.params)
+    params = jax.tree.map(lambda x: np.asarray(x), stages_params)
+    merged = dict(params)
+    merged["layers"] = jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), params["layers"])
+    dense_loss = float(model.loss(merged, {"input_ids": flat_ids}))
+
+    pipe_loss = eng.train_batch(batch={"input_ids": flat_ids})
+    np.testing.assert_allclose(pipe_loss, dense_loss, rtol=2e-3)
