@@ -226,3 +226,154 @@ def barrier() -> None:
         jax.pmap(lambda v: lax.psum(v, "i"), axis_name="i")(
             jnp.ones((jax.local_device_count(),), jnp.int32)))
     del x
+
+
+def monitored_barrier(timeout: float = 300.0) -> float:
+    """Barrier with a wall-time watchdog (reference monitored_barrier,
+    comm.py:375 — its gloo backend names the missing rank; XLA's
+    collectives either complete or the runtime itself raises on a lost
+    host, so the useful signal here is the measured wait).  Returns the
+    barrier wall time in seconds; warns when it exceeds ``timeout``."""
+    import time as _time
+    t0 = _time.time()
+    barrier()
+    dt = _time.time() - t0
+    if dt > timeout:
+        logger.warning(
+            "monitored_barrier: barrier took %.1fs (timeout %.1fs) — a "
+            "host is straggling or the fabric is congested", dt, timeout)
+    return dt
+
+
+def log_summary(show_straggler: bool = False) -> str:
+    """Print + return the comms-volume summary (reference comm.py
+    log_summary; straggler analysis is meaningless under XLA's fused
+    schedules — the profiler owns latency attribution)."""
+    del show_straggler
+    if _comms_logger is None:
+        logger.warning("comms logger not configured; nothing to summarize")
+        return ""
+    text = _comms_logger.log_summary()
+    logger.info("%s", text)
+    return text
+
+
+# ---------------------------------------------------------------------------
+# Rooted collectives + reference-compat aliases.  "Process groups" are mesh
+# axis names (or tuples of them); SPMD requires uniform shapes on every
+# rank, so rooted ops return the payload on the root and a same-shaped
+# dummy elsewhere (the reference returns None / leaves inputs untouched).
+# ---------------------------------------------------------------------------
+
+@timed_op
+def reduce(tensor: jax.Array, axis_name: AxisName, dst: int = 0,
+           op: str = "sum") -> jax.Array:
+    """Rooted reduce (reference comm.py reduce): rank ``dst`` gets the
+    reduction; every other rank keeps its own input."""
+    red = all_reduce.__wrapped__(tensor, axis_name, op)
+    return jnp.where(lax.axis_index(axis_name) == dst, red, tensor)
+
+
+@timed_op
+def gather(tensor: jax.Array, axis_name: AxisName, dst: int = 0,
+           axis: int = 0) -> jax.Array:
+    """Rooted gather (reference comm.py gather): rank ``dst`` gets the
+    stacked shards (new leading dim at ``axis``), others zeros."""
+    g = lax.all_gather(tensor, axis_name, axis=axis, tiled=False)
+    return jnp.where(lax.axis_index(axis_name) == dst, g, jnp.zeros_like(g))
+
+
+@timed_op
+def scatter(tensor: jax.Array, axis_name: AxisName, src: int = 0,
+            axis: int = 0) -> jax.Array:
+    """Rooted scatter (reference comm.py scatter): every rank receives
+    its ``axis``-slice of ``src``'s tensor."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    full = lax.psum(masked, axis_name)
+    n = lax.axis_size(axis_name)
+    if full.shape[axis] % n != 0:
+        raise ValueError(
+            f"scatter: dim {axis} ({full.shape[axis]}) not divisible by "
+            f"axis {axis_name!r} size {n} (torch.distributed rejects "
+            "mismatched scatter sizes; so do we)")
+    return lax.dynamic_slice_in_dim(full, idx * (full.shape[axis] // n),
+                                    full.shape[axis] // n, axis)
+
+
+def inference_all_reduce(tensor: jax.Array, axis_name: AxisName) -> jax.Array:
+    """TP partial-sum reduction in inference kernels (reference
+    inference_all_reduce) — same psum; XLA already skips grad machinery."""
+    return all_reduce(tensor, axis_name)
+
+
+def all_gather_into_tensor(tensor: jax.Array, axis_name: AxisName,
+                           axis: int = 0) -> jax.Array:
+    """reference all_gather_into_tensor / _all_gather_base."""
+    return all_gather(tensor, axis_name, axis=axis)
+
+
+all_gather_base = all_gather_into_tensor
+
+
+def reduce_scatter_tensor(tensor: jax.Array, axis_name: AxisName,
+                          axis: int = 0) -> jax.Array:
+    """reference reduce_scatter_tensor / _reduce_scatter_base."""
+    return reduce_scatter(tensor, axis_name, axis=axis)
+
+
+reduce_scatter_base = reduce_scatter_tensor
+
+
+def all_to_all_single(tensor: jax.Array, axis_name: AxisName,
+                      split_axis: int = 0, concat_axis: int = 0) -> jax.Array:
+    """reference all_to_all_single."""
+    return all_to_all(tensor, axis_name, split_axis, concat_axis)
+
+
+def all_reduce_coalesced(tensors, axis_name: AxisName, op: str = "sum"):
+    """reference all_reduce_coalesced: one call over a list.  No manual
+    coalescing manager needed — XLA's combiner passes merge adjacent
+    collectives into one fused op."""
+    return [all_reduce(t, axis_name, op) for t in tensors]
+
+
+def all_gather_coalesced(tensors, axis_name: AxisName, axis: int = 0):
+    """reference all_gather_coalesced."""
+    return [all_gather(t, axis_name, axis=axis) for t in tensors]
+
+
+def reduce_scatter_coalesced(tensors, axis_name: AxisName, axis: int = 0):
+    """reference reduce_scatter_coalesced."""
+    return [reduce_scatter(t, axis_name, axis=axis) for t in tensors]
+
+
+# -- group shims ------------------------------------------------------------
+
+def new_group(axis_names: Sequence[str]):
+    """Reference ``new_group(ranks)`` -> mesh-axis tuple.  Under SPMD a
+    communicator is not a rank list but a set of mesh axes; every traced
+    collective here takes that tuple directly as ``axis_name``."""
+    if isinstance(axis_names, str):
+        return (axis_names,)
+    return tuple(axis_names)
+
+
+def get_world_group():
+    """All axes of the ambient mesh (None outside a mesh context —
+    collectives then need an explicit axis)."""
+    from ..parallel.topology import ambient_mesh
+    m = ambient_mesh()
+    return tuple(m.axis_names) if m is not None else None
+
+
+def destroy_process_group() -> None:
+    """Tear down the multi-host rendezvous (reference
+    destroy_process_group -> torch.distributed.destroy_process_group)."""
+    global _initialized
+    if _initialized:
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:  # already down / single-host
+            logger.warning("jax.distributed.shutdown: %s", e)
+        _initialized = False

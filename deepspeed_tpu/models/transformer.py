@@ -253,14 +253,9 @@ def _activation(cfg: TransformerConfig, gate, up):
 
 def _ambient_mesh():
     """The Mesh active at trace time (None when single-device/absent)."""
-    try:
-        from jax.interpreters import pxla
-        m = pxla.thread_resources.env.physical_mesh
-        if m is not None and not m.empty and m.devices.size > 1:
-            return m
-    except Exception:
-        pass
-    return None
+    from ..parallel.topology import ambient_mesh
+    m = ambient_mesh()
+    return m if m is not None and m.devices.size > 1 else None
 
 
 def flash_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v) -> jax.Array:

@@ -169,3 +169,64 @@ class TestLRSchedules:
         s = get_lr_schedule("WarmupCosineLR",
                             {"total_num_steps": 100, "warmup_num_steps": 10}, 1e-3)
         assert s(100) < s(50) < s(10)
+
+
+class TestCommBreadth:
+    """Rooted collectives + reference-compat aliases (reference
+    comm.py reduce/gather/scatter, *_coalesced, *_into_tensor)."""
+
+    def _mesh(self):
+        return MeshTopology(TopologyConfig(data=4, tensor=2))
+
+    def test_rooted_reduce(self):
+        topo = self._mesh()
+        x = np.arange(4, dtype=np.float32).reshape(4, 1)
+        f = shard_map(lambda v: dist.reduce(v, "data", dst=2),
+                      mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"))
+        out = np.asarray(f(x)).ravel()
+        assert out[2] == 6.0                      # dst holds the sum
+        assert list(out[[0, 1, 3]]) == [0.0, 1.0, 3.0]  # others keep input
+
+    def test_rooted_gather_scatter_roundtrip(self):
+        topo = self._mesh()
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def body(v):
+            g = dist.gather(v, "data", dst=1, axis=0)   # [4, shard, 1] on dst
+            flat = g.reshape(-1, 1)
+            return dist.scatter(flat, "data", src=1, axis=0)
+
+        f = shard_map(body, mesh=topo.mesh, in_specs=P("data"),
+                      out_specs=P("data"))
+        np.testing.assert_allclose(np.asarray(f(x)), x)
+
+    def test_coalesced_and_aliases(self):
+        topo = self._mesh()
+        x = np.arange(4, dtype=np.float32).reshape(4, 1)
+
+        def body(v):
+            a, b = dist.all_reduce_coalesced([v, 2 * v], "data")
+            c = dist.all_gather_into_tensor(v, "data", axis=0)
+            d = dist.reduce_scatter_tensor(c, "data", axis=0)
+            e = dist.inference_all_reduce(v, "data")
+            return a + b + d + e
+
+        f = shard_map(body, mesh=topo.mesh, in_specs=P("data"),
+                      out_specs=P("data"))
+        out = np.asarray(f(x))
+        # sum=6, 2x-sum=12, rs(all_gather)=4*own, psum=6
+        expect = 6.0 + 12.0 + 4 * x + 6.0
+        np.testing.assert_allclose(out, expect)
+
+    def test_groups_and_host_plane(self):
+        assert dist.new_group("data") == ("data",)
+        assert dist.new_group(["data", "tensor"]) == ("data", "tensor")
+        dt = dist.monitored_barrier(timeout=60.0)
+        assert dt >= 0.0
+        dist.configure_comms_logger(enabled=True)
+        topo = self._mesh()
+        x = np.arange(4, dtype=np.float32).reshape(4, 1)
+        f = shard_map(lambda v: dist.all_reduce(v, "data"),
+                      mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"))
+        f(x)
+        assert "all_reduce" in dist.log_summary()
