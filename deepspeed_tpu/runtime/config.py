@@ -117,6 +117,9 @@ class ZeroConfig(DeepSpeedConfigModel):
     stage3_max_reuse_distance: int = int(1e9)
     stage3_prefetch_bucket_size: int = int(5e7)
     stage3_param_persistence_threshold: int = int(1e5)
+    # total bytes of params kept persistent model-wide (reference default
+    # sys.maxsize = unbounded)
+    stage3_model_persistence_threshold: int = int(2 ** 63 - 1)
     stage3_gather_16bit_weights_on_model_save: bool = False
     zero_hpz_partition_size: int = 1  # ZeRO++ secondary partition
     zero_quantized_weights: bool = False  # ZeRO++ qwZ

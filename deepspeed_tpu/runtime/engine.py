@@ -162,9 +162,9 @@ class DeepSpeedEngine:
             if self.config.precision_dtype != "float16" else jnp.bfloat16
         # fp16 configs keep loss-scaling semantics but compute in bf16 (TPU
         # has no fast fp16); dynamic scaling still guards against inf/nan.
-        self.fp16_enabled = self.config.fp16.enabled
+        self._fp16_enabled = self.config.fp16.enabled
         self.master_dtype = (jnp.float32 if (self.config.bf16.master_weights
-                                             or self.fp16_enabled
+                                             or self._fp16_enabled
                                              or self.config.precision_dtype == "float32")
                              else self.compute_dtype)
 
@@ -401,7 +401,7 @@ class DeepSpeedEngine:
             loss_scale=rep, good_steps=rep, skipped_steps=rep, hysteresis=rep)
 
     def _initial_loss_scale(self) -> float:
-        if not self.fp16_enabled:
+        if not self._fp16_enabled:
             return 1.0
         if self.config.fp16.loss_scale > 0:
             return float(self.config.fp16.loss_scale)
@@ -426,7 +426,7 @@ class DeepSpeedEngine:
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         clip = cfg.gradient_clipping
-        fp16 = self.fp16_enabled
+        fp16 = self._fp16_enabled
         compute_dtype = self.compute_dtype
         loss_fn = self._loss_fn
         optimizer = self.optimizer
@@ -682,6 +682,11 @@ class DeepSpeedEngine:
         else:
             batch = self._shape_batch(batch)
 
+        if not getattr(self, "_train_mode", True):
+            logger.warning(
+                "train_batch called on an engine in eval() mode; the "
+                "batch runs in the TRAIN regime (use eval_batch for "
+                "eval-regime scoring)")
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         with self.topology.mesh:
@@ -693,7 +698,7 @@ class DeepSpeedEngine:
             # device path updates unconditionally in bf16 mode, and the
             # host must mirror it exactly or the two halves desync
             if self.offload is not None and not (
-                    self.fp16_enabled and int(metrics["overflow"])):
+                    self._fp16_enabled and int(metrics["overflow"])):
                 self._apply_offload_step(off_grads,
                                          float(metrics["applied_lr"]))
         loss = float(metrics["loss"])
@@ -702,6 +707,8 @@ class DeepSpeedEngine:
         record_active("model_inputs", "batch", batch)
         record_active("fwd_act", "loss", np.asarray(loss))
         self._last_grad_norm = float(metrics["grad_norm"])
+        self._last_step_applied = not (self._fp16_enabled
+                                       and bool(metrics["overflow"]))
         self.global_steps += 1
         self._maybe_apply_compression()
         self.micro_steps += self.gradient_accumulation_steps()
@@ -786,16 +793,46 @@ class DeepSpeedEngine:
         return loss
 
     def is_gradient_accumulation_boundary(self) -> bool:
+        """True when step() will consume the buffer and update.  An
+        explicit set_gradient_accumulation_boundary overrides the
+        buffer-count rule (reference engine.py semantics)."""
+        if getattr(self, "_ga_boundary", None) is not None:
+            return self._ga_boundary
         return len(self._grad_acc_buffer) >= self.gradient_accumulation_steps()
 
     def step(self):
-        """Consume buffered micro-batches at the GAS boundary and update."""
+        """Consume buffered micro-batches at the GAS boundary and update.
+
+        A forced boundary (set_gradient_accumulation_boundary(True)) can
+        fire with a partial buffer; the update then accumulates over
+        exactly the buffered micro-batches (reference semantics: apply
+        whatever has accumulated), via a one-off step traced for that
+        count."""
         if not self.is_gradient_accumulation_boundary():
             return
+        if not self._grad_acc_buffer:
+            return
+        n = len(self._grad_acc_buffer)
         batch = jax.tree.map(lambda *xs: np.stack(xs), *self._grad_acc_buffer)
         self._grad_acc_buffer = []
-        self.train_batch(batch=jax.tree.map(
-            lambda x: x.reshape((-1,) + x.shape[2:]), batch))
+        flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), batch)
+        gas = self.gradient_accumulation_steps()
+        if n == gas:
+            self.train_batch(batch=flat)
+            return
+        saved_step, saved_tbs = self._train_step, self.config.train_batch_size
+        object.__setattr__(self.config, "gradient_accumulation_steps", n)
+        object.__setattr__(
+            self.config, "train_batch_size",
+            self.config.train_micro_batch_size_per_gpu
+            * self.topology.batch_shard_size * n)
+        self._train_step = self._build_train_step()
+        try:
+            self.train_batch(batch=flat)
+        finally:
+            object.__setattr__(self.config, "gradient_accumulation_steps", gas)
+            object.__setattr__(self.config, "train_batch_size", saved_tbs)
+            self._train_step = saved_step
 
     def eval_batch(self, batch) -> float:
         with self.topology.mesh:
@@ -891,3 +928,298 @@ class DeepSpeedEngine:
                 json.dump({k: "bfloat16" for k in flat}, f)
         logger.info("saved 16-bit model -> %s", path)
         return path
+
+    # ------------------------------------------------------------------
+    # Reference API compatibility surface (engine.py exposes ~100 config
+    # accessors + small state queries that user scripts and the
+    # autotuner read; each one maps onto our pydantic config or engine
+    # state.  Torch-mechanics methods with no TPU meaning — the manual
+    # allreduce-bucket family, graph harvesting, amp — are deliberately
+    # absent: grads reduce inside the jitted step.)
+    # ------------------------------------------------------------------
+
+    def train(self, mode: bool = True):
+        """Reference nn.Module.train passthrough.  Regime here is bound
+        to the PATH, not a module flag: train_batch always runs the
+        train regime, forward/eval_batch always the eval regime (MoE
+        eval capacity, no dropout) — so this only records intent and
+        train_batch warns when called under eval()."""
+        self._train_mode = bool(mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    def zero_grad(self):
+        """No-op: gradients are values inside the jitted step, not
+        buffers (nothing accumulates outside train_batch)."""
+
+    def destroy(self):
+        """Drop compiled steps + device state (reference destroy)."""
+        self._train_step = None
+        self._eval_step = None
+        self.state = None
+
+    def compile(self, *a, **k):
+        """Everything is already jitted by construction (SURVEY: compile
+        support n/a); kept for torch.compile-style call sites."""
+        return self
+
+    def is_compiled(self) -> bool:
+        return True
+
+    def was_step_applied(self) -> bool:
+        """False when the last train_batch was skipped by the fp16
+        overflow guard (reference was_step_applied)."""
+        return getattr(self, "_last_step_applied", True)
+
+    def get_batch_info(self):
+        return (self.train_batch_size(),
+                self.train_micro_batch_size_per_gpu(),
+                self.gradient_accumulation_steps())
+
+    def set_train_batch_size(self, train_batch_size: int):
+        """Elastic rescale (reference set_train_batch_size): must stay
+        consistent with micro * gas * shards."""
+        micro = self.config.train_micro_batch_size_per_gpu
+        shards = self.topology.batch_shard_size
+        if train_batch_size % (micro * shards) != 0:
+            raise ValueError(
+                f"train_batch_size {train_batch_size} != micro {micro} * "
+                f"gas * batch shards {shards}")
+        object.__setattr__(self.config, "train_batch_size", train_batch_size)
+        object.__setattr__(self.config, "gradient_accumulation_steps",
+                           train_batch_size // (micro * shards))
+        self._train_step = self._build_train_step()  # gas is traced in
+
+    def set_train_micro_batch_size(self, micro_batch_size: int):
+        object.__setattr__(self.config, "train_micro_batch_size_per_gpu",
+                           micro_batch_size)
+        object.__setattr__(
+            self.config, "train_batch_size",
+            micro_batch_size * self.config.gradient_accumulation_steps
+            * self.topology.batch_shard_size)
+        self._train_step = self._build_train_step()  # new shapes
+
+    def set_gradient_accumulation_boundary(self, is_boundary: bool):
+        """Force (True) / defer (False) the optimizer update on the
+        legacy forward/backward/step path: overrides
+        is_gradient_accumulation_boundary until cleared with None.
+        train_batch is unaffected (its micro-batches run inside one
+        fused program)."""
+        self._ga_boundary = None if is_boundary is None else bool(is_boundary)
+
+    def dump_state(self):
+        logger.info(
+            "engine state: step=%s lr=%.3e loss_scale=%s skipped=%s "
+            "zero_stage=%s mesh=%s", int(self.state.step), self.get_lr()[0],
+            self.loss_scale, self.skipped_steps, self.zero_stage,
+            dict(self.topology.mesh.shape))
+
+    def memory_breakdown(self):
+        """Per-device memory stats (reference memory_breakdown prints
+        torch.cuda stats; TPU exposes them via device.memory_stats)."""
+        out = []
+        for d in jax.local_devices():
+            try:
+                out.append({"device": str(d), **(d.memory_stats() or {})})
+            except Exception:
+                out.append({"device": str(d)})
+        return out
+
+    # -- config accessors (reference names) -----------------------------
+    def zero_optimization(self) -> bool:
+        return self.zero_stage > 0
+
+    def zero_optimization_stage(self) -> int:
+        return self.zero_stage
+
+    def zero_optimization_partition_gradients(self) -> bool:
+        return self.zero_stage >= 2
+
+    def zero_optimization_partition_weights(self) -> bool:
+        return self.zero_stage >= 3
+
+    def zero_allgather_bucket_size(self) -> int:
+        return self.config.zero_optimization.allgather_bucket_size
+
+    def zero_allgather_partitions(self) -> bool:
+        return self.config.zero_optimization.allgather_partitions
+
+    def zero_reduce_bucket_size(self) -> int:
+        return self.config.zero_optimization.reduce_bucket_size
+
+    def zero_reduce_scatter(self) -> bool:
+        return self.config.zero_optimization.reduce_scatter
+
+    def zero_contiguous_gradients(self) -> bool:
+        return self.config.zero_optimization.contiguous_gradients
+
+    def zero_overlap_comm(self) -> bool:
+        return self.config.zero_optimization.overlap_comm
+
+    def zero_sub_group_size(self) -> int:
+        return self.config.zero_optimization.sub_group_size
+
+    def zero_max_live_parameters(self) -> int:
+        return self.config.zero_optimization.stage3_max_live_parameters
+
+    def zero_max_reuse_distance(self) -> int:
+        return self.config.zero_optimization.stage3_max_reuse_distance
+
+    def zero_prefetch_bucket_size(self) -> int:
+        return self.config.zero_optimization.stage3_prefetch_bucket_size
+
+    def zero_param_persistence_threshold(self) -> int:
+        return self.config.zero_optimization.stage3_param_persistence_threshold
+
+    def zero_model_persistence_threshold(self) -> int:
+        return self.config.zero_optimization.stage3_model_persistence_threshold
+
+    def zero_gather_16bit_weights_on_model_save(self) -> bool:
+        return (self.config.zero_optimization
+                .stage3_gather_16bit_weights_on_model_save)
+
+    def zero_hpz_partition_size(self) -> int:
+        return self.config.zero_optimization.zero_hpz_partition_size
+
+    def zero_quantized_weights(self) -> bool:
+        return self.config.zero_optimization.zero_quantized_weights
+
+    def zero_quantized_gradients(self) -> bool:
+        return self.config.zero_optimization.zero_quantized_gradients
+
+    def mics_shard_size(self) -> int:
+        return self.config.zero_optimization.mics_shard_size
+
+    def zero_cpu_offload(self) -> bool:
+        return self.config.zero_optimization.offload_optimizer.device \
+            in ("cpu", "nvme")
+
+    def zero_offload_param(self):
+        return self.config.zero_optimization.offload_param
+
+    def zero_offload_optimizer(self):
+        return self.config.zero_optimization.offload_optimizer
+
+    def zero_has_nvme_offload(self) -> bool:
+        return ("nvme" in (self.config.zero_optimization
+                           .offload_optimizer.device,
+                           self.config.zero_optimization.offload_param.device))
+
+    def zero_round_robin_gradients(self) -> bool:
+        return self.config.zero_optimization.round_robin_gradients
+
+    def fp16_enabled(self) -> bool:
+        return self.config.fp16.enabled
+
+    def bfloat16_enabled(self) -> bool:
+        return self.config.bf16.enabled
+
+    def fp16_auto_cast(self) -> bool:
+        return self.config.fp16.auto_cast
+
+    def fp16_master_weights_and_gradients(self) -> bool:
+        """Reference meaning: masters/grads kept in fp16 to halve
+        optimizer memory.  Always False here — under fp16 configs the
+        TPU engine keeps fp32 masters (bf16 is the compute dtype; there
+        is no fp16 master mode to save memory with)."""
+        return False
+
+    def dynamic_loss_scale(self) -> bool:
+        return self.config.fp16.loss_scale == 0
+
+    def initial_dynamic_scale(self) -> float:
+        return 2.0 ** self.config.fp16.initial_scale_power
+
+    def dynamic_loss_scale_args(self):
+        c = self.config.fp16
+        return {"init_scale": 2.0 ** c.initial_scale_power,
+                "scale_window": c.loss_scale_window,
+                "delayed_shift": c.hysteresis,
+                "min_scale": c.min_loss_scale}
+
+    def gradient_clipping(self) -> float:
+        return self.config.gradient_clipping
+
+    def gradient_predivide_factor(self) -> float:
+        return self.config.gradient_predivide_factor
+
+    def postscale_gradients(self) -> bool:
+        return not self.config.prescale_gradients
+
+    def communication_data_type(self) -> str:
+        return self.config.communication_data_type or "bfloat16"
+
+    def sparse_gradients_enabled(self) -> bool:
+        return self.config.sparse_gradients
+
+    def steps_per_print(self) -> int:
+        return self.config.steps_per_print
+
+    def wall_clock_breakdown(self) -> bool:
+        return self.config.wall_clock_breakdown
+
+    def optimizer_name(self) -> str:
+        return self.config.optimizer.type
+
+    def optimizer_params(self):
+        return self.config.optimizer.params
+
+    def scheduler_name(self):
+        return self.config.scheduler.type if self.config.scheduler else None
+
+    def scheduler_params(self):
+        return self.config.scheduler.params if self.config.scheduler else None
+
+    def elasticity_enabled(self) -> bool:
+        return self.config.elasticity.enabled
+
+    def autotuning_enabled(self) -> bool:
+        return self.config.autotuning.enabled
+
+    def flops_profiler_enabled(self) -> bool:
+        return self.config.flops_profiler.enabled
+
+    def flops_profiler_profile_step(self) -> int:
+        return self.config.flops_profiler.profile_step
+
+    def aio_config(self):
+        return getattr(self.config.tpu, "aio", None)
+
+    def data_efficiency_enabled(self) -> bool:
+        return self.config.data_efficiency.enabled
+
+    def data_efficiency_config(self):
+        return self.config.data_efficiency
+
+    def data_sampling_enabled(self) -> bool:
+        return bool(self.config.data_efficiency.data_sampling.get(
+            "enabled", False))
+
+    def data_sampling_config(self):
+        return self.config.data_efficiency.data_sampling
+
+    def curriculum_learning_enabled(self) -> bool:
+        return bool(self.config.data_efficiency.data_sampling.get(
+            "curriculum_learning", {}).get("enabled", False))
+
+    def curriculum_learning_config(self):
+        return self.config.data_efficiency.data_sampling.get(
+            "curriculum_learning", {})
+
+    def random_ltd_enabled(self) -> bool:
+        return bool(self.config.data_efficiency.data_routing.get(
+            "random_ltd", {}).get("enabled", False))
+
+    def random_ltd_config(self):
+        return self.config.data_efficiency.data_routing.get("random_ltd", {})
+
+    def module_state_dict(self):
+        """Reference module_state_dict -> consolidated host params."""
+        return self.get_fp32_state_dict()
+
+    def save_fp16_model(self, save_dir: str,
+                        filename: str = "model_weights.npz"):
+        """Deprecated reference alias of save_16bit_model."""
+        return self.save_16bit_model(save_dir, filename)

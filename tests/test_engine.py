@@ -224,3 +224,49 @@ def test_forward_backward_step_compat():
         engine.backward(loss)
         engine.step()
     assert int(engine.state.step) == step0 + 1  # one optimizer step after gas=2
+
+
+def test_reference_compat_accessors():
+    """The reference engine's config-accessor surface (engine.py exposes
+    ~100 of these; user scripts and the autotuner read them)."""
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.models.base import SimpleModel
+    eng, *_ = dst.initialize(model=SimpleModel(16), config={
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 0.7,
+    })
+    assert eng.zero_optimization() and eng.zero_optimization_stage() == 2
+    assert eng.zero_optimization_partition_gradients()
+    assert not eng.zero_optimization_partition_weights()
+    assert eng.bfloat16_enabled() and not eng.fp16_enabled()
+    assert eng.gradient_clipping() == 0.7
+    assert eng.optimizer_name() == "adamw"
+    assert eng.dynamic_loss_scale()
+    assert eng.get_batch_info()[1] == 4
+    assert eng.was_step_applied()  # no step yet -> default True
+    assert isinstance(eng.memory_breakdown(), list)
+    assert eng.compile() is eng and eng.is_compiled()
+    eng.train(False)
+    eng.dump_state()
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    bs = eng.train_batch_size()
+    batch = {"x": rng.normal(size=(bs, 16)).astype(np.float32),
+             "y": rng.normal(size=(bs, 16)).astype(np.float32)}
+    first = eng.train_batch(batch)
+    assert eng.was_step_applied()
+
+    eng.set_train_batch_size(bs * 2)  # gas 2 -> 4
+    assert eng.gradient_accumulation_steps() == 4
+    batch2 = {"x": rng.normal(size=(bs * 2, 16)).astype(np.float32),
+              "y": rng.normal(size=(bs * 2, 16)).astype(np.float32)}
+    assert np.isfinite(eng.train_batch(batch2))
+    try:
+        eng.set_train_batch_size(bs * 2 + 1)
+        raise AssertionError("inconsistent batch accepted")
+    except ValueError:
+        pass
