@@ -682,7 +682,9 @@ class DeepSpeedEngine:
         else:
             batch = self._shape_batch(batch)
 
-        if not getattr(self, "_train_mode", True):
+        if not getattr(self, "_train_mode", True) and \
+                not getattr(self, "_eval_mode_warned", False):
+            self._eval_mode_warned = True
             logger.warning(
                 "train_batch called on an engine in eval() mode; the "
                 "batch runs in the TRAIN regime (use eval_batch for "
@@ -821,13 +823,18 @@ class DeepSpeedEngine:
             self.train_batch(batch=flat)
             return
         saved_step, saved_tbs = self._train_step, self.config.train_batch_size
-        object.__setattr__(self.config, "gradient_accumulation_steps", n)
-        object.__setattr__(
-            self.config, "train_batch_size",
-            self.config.train_micro_batch_size_per_gpu
-            * self.topology.batch_shard_size * n)
-        self._train_step = self._build_train_step()
+        cache = getattr(self, "_partial_step_cache", None)
+        if cache is None:
+            cache = self._partial_step_cache = {}
         try:
+            object.__setattr__(self.config, "gradient_accumulation_steps", n)
+            object.__setattr__(
+                self.config, "train_batch_size",
+                self.config.train_micro_batch_size_per_gpu
+                * self.topology.batch_shard_size * n)
+            if n not in cache:  # one trace+compile per distinct count
+                cache[n] = self._build_train_step()
+            self._train_step = cache[n]
             self.train_batch(batch=flat)
         finally:
             object.__setattr__(self.config, "gradient_accumulation_steps", gas)
@@ -991,6 +998,7 @@ class DeepSpeedEngine:
         object.__setattr__(self.config, "gradient_accumulation_steps",
                            train_batch_size // (micro * shards))
         self._train_step = self._build_train_step()  # gas is traced in
+        self.tput_timer.batch_size = train_batch_size
 
     def set_train_micro_batch_size(self, micro_batch_size: int):
         object.__setattr__(self.config, "train_micro_batch_size_per_gpu",
@@ -1000,6 +1008,7 @@ class DeepSpeedEngine:
             micro_batch_size * self.config.gradient_accumulation_steps
             * self.topology.batch_shard_size)
         self._train_step = self._build_train_step()  # new shapes
+        self.tput_timer.batch_size = self.config.train_batch_size
 
     def set_gradient_accumulation_boundary(self, is_boundary: bool):
         """Force (True) / defer (False) the optimizer update on the
@@ -1185,7 +1194,9 @@ class DeepSpeedEngine:
         return self.config.flops_profiler.profile_step
 
     def aio_config(self):
-        return getattr(self.config.tpu, "aio", None)
+        """Top-level ``aio`` section (reference config layout; parses
+        into the pydantic extra fields)."""
+        return getattr(self.config, "aio", None)
 
     def data_efficiency_enabled(self) -> bool:
         return self.config.data_efficiency.enabled

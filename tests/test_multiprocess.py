@@ -1,0 +1,107 @@
+"""Multi-process distributed tests — REAL cross-process collectives
+(reference tests/unit/comm/test_dist.py + the DistributedTest harness
+itself; multi-node is simulated by local ranks as the reference does).
+
+These run outside the shared 8-device virtual mesh of conftest: each
+rank is its own interpreter with one CPU device, joined by
+jax.distributed, so the host-plane (init_distributed, rank/world) and
+the device-plane (cross-process psum, sharded train step) are both
+exercised for real.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_harness import run_distributed
+
+
+@pytest.mark.slow
+class TestMultiProcess:
+    def test_init_and_cross_process_psum(self):
+        outs = run_distributed("""
+        import deepspeed_tpu.comm as dist
+        import jax, jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        dist.init_distributed()
+        assert dist.get_rank() == RANK and dist.get_world_size() == WORLD
+        assert dist.get_device_count() == WORLD  # 1 device per process
+        devs = jax.devices()
+        assert len(devs) == WORLD
+        mesh = Mesh(devs, ("data",))
+        x = jnp.asarray([float(RANK + 1)])
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("data")), x, (WORLD,))
+        total = jax.jit(lambda a: jnp.sum(a),
+                        out_shardings=NamedSharding(mesh, P()))(arr)
+        assert float(total) == sum(range(1, WORLD + 1)), float(total)
+        dist.barrier()
+        print("PSUM_OK", RANK, float(total))
+        """)
+        for rank, out in enumerate(outs):
+            assert f"PSUM_OK {rank} 3.0" in out, out[-500:]
+
+    def test_zero1_training_across_processes(self):
+        """ZeRO-1 data-parallel training over 2 processes: every rank
+        computes the same loss trajectory (grad psum crosses the
+        process boundary) and it decreases."""
+        outs = run_distributed("""
+        import numpy as np
+        import deepspeed_tpu as dst
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.models.base import SimpleModel
+        from deepspeed_tpu.parallel.topology import MeshTopology, TopologyConfig
+
+        dist.init_distributed()
+        topo = MeshTopology(TopologyConfig(data=2))
+        eng, *_ = dst.initialize(model=SimpleModel(16), topology=topo, config={
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1}})
+        rng = np.random.default_rng(0)  # same seed -> same GLOBAL batch
+        bs = eng.train_batch_size()
+        batch = {"x": rng.normal(size=(bs, 16)).astype(np.float32),
+                 "y": rng.normal(size=(bs, 16)).astype(np.float32)}
+        losses = [float(eng.train_batch(batch)) for _ in range(3)]
+        assert losses[-1] < losses[0], losses
+        print("LOSSES", " ".join(f"{l:.6f}" for l in losses))
+        """)
+        trajectories = {out.split("LOSSES ")[1].splitlines()[0]
+                        for out in outs}
+        assert len(trajectories) == 1, f"ranks diverged: {trajectories}"
+
+    def test_zero3_param_sharding_across_processes(self):
+        """ZeRO-3: params shard over an fsdp axis that spans BOTH
+        processes; each rank holds only its addressable shard bytes."""
+        outs = run_distributed("""
+        import numpy as np
+        import deepspeed_tpu as dst
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.models.base import SimpleModel
+        from deepspeed_tpu.parallel.topology import MeshTopology, TopologyConfig
+
+        dist.init_distributed()
+        topo = MeshTopology(TopologyConfig(fsdp=2))
+        eng, *_ = dst.initialize(model=SimpleModel(32), topology=topo, config={
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3,
+                                  "stage3_param_persistence_threshold": 0}})
+        total = local = 0
+        for leaf in __import__("jax").tree.leaves(eng.state.params):
+            total += leaf.size * leaf.dtype.itemsize
+            local += sum(s.data.size * s.data.dtype.itemsize
+                         for s in leaf.addressable_shards)
+        assert local <= total // 2 + 1024, (local, total)
+        rng = np.random.default_rng(0)
+        bs = eng.train_batch_size()
+        batch = {"x": rng.normal(size=(bs, 32)).astype(np.float32),
+                 "y": rng.normal(size=(bs, 32)).astype(np.float32)}
+        loss = float(eng.train_batch(batch))
+        assert np.isfinite(loss)
+        print("ZERO3_OK", RANK, f"{local}/{total}")
+        """)
+        for rank, out in enumerate(outs):
+            assert f"ZERO3_OK {rank}" in out, out[-500:]
