@@ -21,6 +21,7 @@ MODEL_SIZE = os.environ.get("BENCH_MODEL", "1b")
 SEQ_LEN = int(os.environ.get("BENCH_SEQ", "2048"))
 MICRO_BS = int(os.environ.get("BENCH_BS", "4"))
 STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+REMAT_POLICY = os.environ.get("BENCH_REMAT", "save_attn_out")
 
 # peak bf16 FLOPs/s per chip (TPU v5e ~ 394 TFLOPs int8 / 197 bf16)
 PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
@@ -186,7 +187,7 @@ def main():
         "zero_optimization": {"stage": 3},
         "bf16": {"enabled": True, "master_weights": False},
         "steps_per_print": 10 ** 9,
-        "tpu": {"remat_policy": "nothing_saveable"},
+        "tpu": {"remat_policy": REMAT_POLICY},
     }
     engine, _, _, _ = dst.initialize(model=model, config=config)
     bs = engine.train_batch_size()
@@ -217,6 +218,8 @@ def main():
         "value": round(tok_s_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4),
+        "remat_policy": REMAT_POLICY,
+        "micro_bs": MICRO_BS,
     }
     del engine  # release training buffers before the inference leg
     if os.environ.get("BENCH_FASTGEN", "1") != "0":

@@ -846,9 +846,17 @@ class DeepSpeedEngine:
             placed = self._place_batch(batch, microbatched=False)
             return float(self._eval_step(self.state, placed, self._next_rng()))
 
+    def _invalidate_step_caches(self):
+        """Anything that changes what a trace would bake in (lr
+        schedule, batch geometry) must drop cached partial-count steps
+        too."""
+        if getattr(self, "_partial_step_cache", None):
+            self._partial_step_cache.clear()
+
     def set_lr(self, lr: float):
         self._schedule = lambda step: lr
         self._train_step = self._build_train_step()
+        self._invalidate_step_caches()
 
     # --- dataloader ----------------------------------------------------
     def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, **kw):
@@ -965,6 +973,7 @@ class DeepSpeedEngine:
         """Drop compiled steps + device state (reference destroy)."""
         self._train_step = None
         self._eval_step = None
+        self._invalidate_step_caches()
         self.state = None
 
     def compile(self, *a, **k):
@@ -998,6 +1007,7 @@ class DeepSpeedEngine:
         object.__setattr__(self.config, "gradient_accumulation_steps",
                            train_batch_size // (micro * shards))
         self._train_step = self._build_train_step()  # gas is traced in
+        self._invalidate_step_caches()
         self.tput_timer.batch_size = train_batch_size
 
     def set_train_micro_batch_size(self, micro_batch_size: int):
@@ -1008,6 +1018,7 @@ class DeepSpeedEngine:
             micro_batch_size * self.config.gradient_accumulation_steps
             * self.topology.batch_shard_size)
         self._train_step = self._build_train_step()  # new shapes
+        self._invalidate_step_caches()
         self.tput_timer.batch_size = self.config.train_batch_size
 
     def set_gradient_accumulation_boundary(self, is_boundary: bool):

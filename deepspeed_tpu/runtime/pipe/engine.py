@@ -69,7 +69,8 @@ def gpipe_spmd(mesh,
                remat: bool = True,
                first_fn: Optional[Callable] = None,
                last_fn: Optional[Callable] = None,
-               edge_params: Any = None) -> Any:
+               edge_params: Any = None,
+               stage_aux: bool = False) -> Any:
     """Differentiable pipelined map over the 'pipe' mesh axis.
 
     ``stage_params`` leaves carry a leading stage dim (global size S,
@@ -103,6 +104,13 @@ def gpipe_spmd(mesh,
     touches must enter through arguments — shard_map closure capture of
     sharded arrays clashes with the Manual-mode mesh — and ``consts`` is
     stop-gradiented, so differentiable edge weights get their own slot.
+
+    ``stage_aux``: stage_fn returns ``(activation, aux_scalar)`` and the
+    call returns ``(result, aux_total)`` — the MoE gating load-balance
+    loss threaded through the pipeline carry (differentiable; only
+    active ticks contribute, and the per-stage accumulators are summed
+    over 'pipe').  aux_total sums over micro-batches; divide by M for
+    the per-forward mean the dense path reports.
     """
     S = num_stages
     if S == 1:
@@ -114,11 +122,15 @@ def gpipe_spmd(mesh,
             mb_id, inp = im
             act = first_fn(edge_params, inp, consts, mb_id) if first_fn else inp
             out = body(sp, act, consts, mb_id)
-            return last_fn(edge_params, out, consts, mb_id) if last_fn else out
-        res = jax.lax.map(one, (jnp.arange(M), x))
+            aux = jnp.zeros((), jnp.float32)
+            if stage_aux:
+                out, aux = out
+            res = last_fn(edge_params, out, consts, mb_id) if last_fn else out
+            return res, aux
+        res, auxs = jax.lax.map(one, (jnp.arange(M), x))
         if last_fn:
-            return jax.tree.map(lambda a: a.sum(0), res)
-        return res
+            res = jax.tree.map(lambda a: a.sum(0), res)
+        return (res, auxs.sum()) if stage_aux else res
 
     param_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
     perm = [(i, (i + 1) % S) for i in range(S)]
@@ -191,32 +203,42 @@ def gpipe_spmd(mesh,
                         act.dtype),
                     lambda: act)
             mb_id = jnp.clip(t - stage, 0, M - 1)
-            return body(sp, inp, consts, mb_id)
+            out = body(sp, inp, consts, mb_id)
+            aux = jnp.zeros((), jnp.float32)
+            if stage_aux:
+                out, aux = out
+            # this stage did real work at tick t iff its micro-batch
+            # index is in range (fill/drain ticks recompute clipped mbs)
+            active = jnp.logical_and(t >= stage, t - stage < M)
+            return out, jnp.where(active, aux, 0.0)
 
         if last_fn is None:
             def tick(carry, t):
-                act, outputs = carry
-                out = tick_common(act, t)
+                act, outputs, aux_acc = carry
+                out, aux = tick_common(act, t)
                 # last stage finishes micro-batch t-(S-1) at tick t.
                 out_idx = jnp.clip(t - (S - 1), 0, M - 1)
                 upd = jax.lax.dynamic_update_index_in_dim(
                     outputs, out, out_idx, 0)
                 outputs = jnp.where(t >= S - 1, upd, outputs)
                 nxt = jax.lax.ppermute(out, PIPE_AXIS, perm)
-                return (nxt, outputs), None
+                return (nxt, outputs, aux_acc + aux), None
 
-            init = (act0, jnp.zeros((M,) + act0.shape, act0.dtype))
-            (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(T))
+            init = (act0, jnp.zeros((M,) + act0.shape, act0.dtype),
+                    jnp.zeros((), jnp.float32))
+            (_, outputs, aux_acc), _ = jax.lax.scan(tick, init,
+                                                    jnp.arange(T))
             # Stack per-stage output buffers over 'pipe': the caller
             # slices the last stage's (the only meaningful one).
-            return outputs[None]
+            aux_tot = jax.lax.psum(aux_acc, PIPE_AXIS)  # sum stages
+            return outputs[None], aux_tot[None]
 
         # reduce mode: accumulate last_fn contributions, no [M] buffer
         acc0 = jax.tree.map(lambda l: jnp.zeros(l.shape, l.dtype), acc_sds)
 
         def tick(carry, t):
-            act, acc = carry
-            out = tick_common(act, t)
+            act, acc, aux_acc = carry
+            out, aux = tick_common(act, t)
             out_mb = jnp.clip(t - (S - 1), 0, M - 1)
             valid = jnp.logical_and(t >= S - 1, stage == S - 1)
             # lax.cond: non-last stages (and fill ticks) skip the
@@ -230,17 +252,21 @@ def gpipe_spmd(mesh,
                     lambda l: jnp.zeros(l.shape, l.dtype), acc_sds))
             acc = jax.tree.map(lambda a, c: a + c, acc, contrib)
             nxt = jax.lax.ppermute(out, PIPE_AXIS, perm)
-            return (nxt, acc), None
+            return (nxt, acc, aux_acc + aux), None
 
-        (_, acc), _ = jax.lax.scan(tick, (act0, acc0), jnp.arange(T))
+        (_, acc, aux_acc), _ = jax.lax.scan(
+            tick, (act0, acc0, jnp.zeros((), jnp.float32)), jnp.arange(T))
         # only the last stage accumulated; psum broadcasts it to all
         acc = jax.tree.map(lambda a: jax.lax.psum(a, PIPE_AXIS), acc)
-        return jax.tree.map(lambda a: a[None], acc)
+        aux_tot = jax.lax.psum(aux_acc, PIPE_AXIS)
+        return jax.tree.map(lambda a: a[None], acc), aux_tot[None]
 
-    res = region(stage_params, edge_in, x_in, consts)
+    res, aux = region(stage_params, edge_in, x_in, consts)
     if last_fn is None:
-        return res[-1]
-    return jax.tree.map(lambda a: a[0], res)
+        out = res[-1]
+    else:
+        out = jax.tree.map(lambda a: a[0], res)
+    return (out, aux[0]) if stage_aux else out
 
 
 # ---------------------------------------------------------------------------
@@ -297,17 +323,17 @@ class PipelinedCausalLM:
         self.num_stages = num_stages
         self.schedule = schedule
         self.mesh = None  # set by PipelineEngine once topology exists
-        if getattr(model, "is_moe", False) or hasattr(model, "moe_cfg"):
-            raise NotImplementedError(
-                "MoE models under PipelineEngine are not yet supported "
-                "(the pipeline carry does not thread the gating aux loss); "
-                "use expert parallelism without 'pipe', or a dense model")
+        # MoE: the gating aux loss threads through the pipeline carry
+        # (gpipe_spmd stage_aux); gate noise is disabled under the
+        # pipeline (rng cannot enter the Manual-mode region as a
+        # closure), matching the deterministic top-k default
+        self.moe_cfg = getattr(model, "moe_cfg", None)
 
     def init_params(self, rng):
         return stack_stages(self.inner.init_params(rng), self.num_stages)
 
     # -- loss ------------------------------------------------------------
-    def loss(self, params, batch, rng=None):
+    def loss(self, params, batch, rng=None, is_training=True):
         """batch leaves are micro-batched: {'input_ids': [M, mb, s], ...}."""
         assert self.mesh is not None, "PipelineEngine must set .mesh"
         cfg = self.cfg
@@ -344,6 +370,16 @@ class PipelinedCausalLM:
         if labels_all is not None:
             labels_all = labels_all.reshape(M, b, s)
 
+        moe_cfg = self.moe_cfg
+        if moe_cfg is not None:
+            from ...moe.layer import moe_forward
+            training = is_training  # eval regime: eval_capacity_factor
+
+            def mlp_fn(c, p, h):
+                return moe_forward(moe_cfg, p, h, is_training=training)
+        else:
+            mlp_fn = None
+
         def stage_fn(stage_layers, act, consts, mb_id):
             sin, cos, mask = jax.tree.map(
                 lambda c: jax.lax.dynamic_index_in_dim(c, mb_id, 0,
@@ -354,11 +390,13 @@ class PipelinedCausalLM:
                   if cfg.pos_emb == "alibi" else None)
 
             def layer(carry, lp):
-                y, _ = tfm._layer_body(cfg, lp, carry, sin, cos, mask,
-                                       attn_bias=ab)
-                return y, None
-            out, _ = jax.lax.scan(layer, act, stage_layers)
-            return out
+                h, aux_acc = carry
+                y, aux = tfm._layer_body(cfg, lp, h, sin, cos, mask,
+                                         mlp_fn=mlp_fn, attn_bias=ab)
+                return (y, aux_acc + aux), None
+            (out, aux), _ = jax.lax.scan(
+                layer, (act, jnp.zeros((), jnp.float32)), stage_layers)
+            return (out, aux) if moe_cfg is not None else out
 
         def head_and_ce(edge, h_mb, consts, mb_id):
             """Final norm + lm head + CE for ONE micro-batch ->
@@ -419,12 +457,19 @@ class PipelinedCausalLM:
                     if attn_mask is not None else None)
             abias_c = (abias_all if abias_all is not None
                        else jnp.zeros((M, 1), jnp.float32))  # never indexed
-            loss_sum, count = gpipe_spmd(
+            res = gpipe_spmd(
                 self.mesh, self.num_stages, stage_fn, params["layers"], ids,
                 consts=(sin, cos, mask, abias_c, ids, labels_all, am_c,
                         positions),
                 remat=cfg.remat,
-                first_fn=embed_mb, last_fn=head_and_ce, edge_params=edge)
+                first_fn=embed_mb, last_fn=head_and_ce, edge_params=edge,
+                stage_aux=moe_cfg is not None)
+            if moe_cfg is not None:
+                (loss_sum, count), aux = res
+                # aux summed over micro-batches -> per-forward mean, the
+                # dense path's convention (mixtral loss = ce + aux)
+                return loss_sum / jnp.maximum(count, 1.0) + aux / M
+            loss_sum, count = res
             return loss_sum / jnp.maximum(count, 1.0)
 
         # gpipe: stack all outputs, one full-batch head/CE
@@ -438,7 +483,12 @@ class PipelinedCausalLM:
                              consts=(sin, cos, mask,
                                      abias_all if abias_all is not None
                                      else jnp.zeros((M, 1), jnp.float32)),
-                             remat=cfg.remat)   # [M,b,s,e]
+                             remat=cfg.remat,
+                             stage_aux=moe_cfg is not None)   # [M,b,s,e]
+        aux_mean = jnp.zeros((), jnp.float32)
+        if moe_cfg is not None:
+            outputs, aux_tot = outputs
+            aux_mean = aux_tot / M
         h = tfm._norm_apply(cfg, params["final_norm"],
                             outputs.reshape(M * b, s, -1))
         if cfg.tie_embeddings:
@@ -452,17 +502,19 @@ class PipelinedCausalLM:
         attn_flat = attn_mask.reshape(M * b, s) if attn_mask is not None else None
         if "labels" in batch:
             labels = batch["labels"].reshape(M * b, s)
-            return tfm.cross_entropy_loss(logits, labels, attn_flat)
+            return tfm.cross_entropy_loss(logits, labels,
+                                          attn_flat) + aux_mean
         labels = ids.reshape(M * b, s)[:, 1:]
         return tfm.cross_entropy_loss(
             logits[:, :-1], labels,
-            attn_flat[:, 1:] if attn_flat is not None else None)
+            attn_flat[:, 1:] if attn_flat is not None else None) + aux_mean
 
     def eval_loss(self, params, batch, rng=None):
-        """Non-micro-batched batch: add a leading M=1 dim."""
+        """Non-micro-batched batch: add a leading M=1 dim; MoE gating
+        runs in the eval regime (eval_capacity_factor, no noise)."""
         batch = {k: v[None] if hasattr(v, "ndim") else v
                  for k, v in batch.items()}
-        return self.loss(params, batch, rng)
+        return self.loss(params, batch, rng, is_training=False)
 
 
 # ---------------------------------------------------------------------------

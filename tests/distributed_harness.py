@@ -49,9 +49,12 @@ def run_distributed(body_src: str, world_size: int = 2,
     whole group is killed on first failure (reference DistributedExec
     timeout kill).
     """
+    import tempfile
+    import time
+
     code = _PREAMBLE.format(repo=_REPO) + textwrap.dedent(body_src)
     port = _free_port()
-    procs = []
+    procs, logs = [], []
     for rank in range(world_size):
         env = dict(os.environ)
         env.update({
@@ -60,24 +63,47 @@ def run_distributed(body_src: str, world_size: int = 2,
             "JAX_PLATFORMS": "cpu",
         })
         env.pop("XLA_FLAGS", None)
+        # stdout to a file, not a pipe: a chatty rank can never block on
+        # a full pipe buffer and stall the group's collectives
+        log = tempfile.TemporaryFile(mode="w+")
+        logs.append(log)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", code], env=env, cwd=_REPO,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            stdout=log, stderr=subprocess.STDOUT, text=True,
             start_new_session=True))
-    outs: List[str] = [""] * world_size
-    deadline = __import__("time").monotonic() + timeout
+
+    def read_log(rank: int) -> str:
+        logs[rank].seek(0)
+        return logs[rank].read()
+
+    deadline = time.monotonic() + timeout
+    failed = None  # (rank, rc)
     try:
-        for rank, p in enumerate(procs):
-            remaining = max(1.0, deadline - __import__("time").monotonic())
-            out, _ = p.communicate(timeout=remaining)
-            outs[rank] = out
-            if p.returncode != 0:
+        # poll ALL ranks so the first failure is seen immediately, even
+        # while an earlier rank blocks in a rendezvous/collective
+        pending = set(range(world_size))
+        while pending and failed is None:
+            if time.monotonic() > deadline:
                 raise AssertionError(
-                    f"distributed rank {rank}/{world_size} exited "
-                    f"rc={p.returncode}:\n{out[-4000:]}")
-    except subprocess.TimeoutExpired:
-        raise AssertionError(
-            f"distributed world of {world_size} timed out after {timeout}s")
+                    f"distributed world of {world_size} timed out after "
+                    f"{timeout}s; rank outputs:\n" + "\n".join(
+                        f"--- rank {r} ---\n{read_log(r)[-1500:]}"
+                        for r in range(world_size)))
+            for rank in sorted(pending):
+                rc = procs[rank].poll()
+                if rc is None:
+                    continue
+                pending.discard(rank)
+                if rc != 0:
+                    failed = (rank, rc)
+                    break
+            time.sleep(0.1)
+        if failed is not None:
+            rank, rc = failed
+            raise AssertionError(
+                f"distributed rank {rank}/{world_size} exited rc={rc}:\n"
+                f"{read_log(rank)[-4000:]}")
+        return [read_log(r) for r in range(world_size)]
     finally:
         for p in procs:
             if p.poll() is None:
@@ -85,4 +111,8 @@ def run_distributed(body_src: str, world_size: int = 2,
                     os.killpg(p.pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     p.kill()
-    return outs
+        for p in procs:
+            if p.poll() is None:
+                p.wait(timeout=10)
+        for log in logs:
+            log.close()

@@ -144,3 +144,29 @@ class TestTraining:
         batch = {"input_ids": masked, "labels": labels}
         losses = [engine.train_batch(batch) for _ in range(6)]
         assert losses[-1] < losses[0]
+
+
+def test_save_attn_out_remat_policy():
+    """The save_attn_out policy must trace and match other policies'
+    loss (remat changes scheduling, not math)."""
+    import dataclasses
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    from flax.core import meta
+    m = LlamaForCausalLM("tiny")
+    params = meta.unbox(m.init_params(jax.random.key(0)))
+    ids = np.arange(2 * 16, dtype=np.int32).reshape(2, 16) % m.cfg.vocab_size
+
+    def loss_with(policy):
+        cfg = dataclasses.replace(m.cfg, dtype=jnp.float32,
+                                  remat_policy=policy)
+        def f(p):
+            logits = forward(cfg, p, ids)
+            return jnp.mean(logits ** 2)
+        l, g = jax.value_and_grad(f)(params)
+        return float(l), g
+
+    l_ref, g_ref = loss_with("nothing_saveable")
+    l_new, g_new = loss_with("save_attn_out")
+    assert abs(l_ref - l_new) < 1e-5
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), g_ref, g_new)

@@ -372,3 +372,42 @@ def test_pipeline_engine_matches_dense_alibi():
 
     pipe_loss = eng.train_batch(batch={"input_ids": flat_ids})
     np.testing.assert_allclose(pipe_loss, dense_loss, rtol=2e-3)
+
+
+def test_pipeline_moe_matches_dense():
+    """Mixtral (MoE) through the pipeline: the gating aux loss threads
+    the carry, and the pipeline loss equals the dense per-micro-batch
+    mean (regression: MoE under PipelineEngine raised
+    NotImplementedError)."""
+    import dataclasses
+    from deepspeed_tpu.models.mixtral import MixtralForCausalLM
+    model = MixtralForCausalLM("debug", num_experts=2, top_k=1)
+    model.cfg = dataclasses.replace(model.cfg, dtype=jnp.float32,
+                                    remat=False)
+    cfg = dict(CFG)
+    cfg["train_batch_size"] = 16
+    cfg["tpu"] = {"mesh": {"pipe": 2, "data": 4}}
+    eng = PipelineEngine(model=model, config=cfg)
+
+    M, b, s = 4, 4, 16
+    batch = _batch(M=M, b=b, s=s, vocab=model.cfg.vocab_size)
+    flat_ids = batch["input_ids"].reshape(M * b, s)
+
+    stages_params = jax.device_get(eng.state.params)
+    params = jax.tree.map(lambda x: np.asarray(x), stages_params)
+    merged = dict(params)
+    merged["layers"] = jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), params["layers"])
+    # dense reference with the PIPELINE's loss convention: mean of
+    # per-micro-batch losses (each = ce + aux for that forward)
+    per_mb = [float(model.loss(merged,
+                               {"input_ids": batch["input_ids"][m]}))
+              for m in range(M)]
+    dense_loss = float(np.mean(per_mb))
+
+    pipe_loss = eng.train_batch(batch={"input_ids": flat_ids})
+    np.testing.assert_allclose(pipe_loss, dense_loss, rtol=2e-3)
+
+    for _ in range(3):
+        last = eng.train_batch(batch={"input_ids": flat_ids})
+    assert last < pipe_loss
