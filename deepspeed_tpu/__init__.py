@@ -14,6 +14,12 @@ from .accelerator import get_accelerator  # noqa: F401
 from .parallel.topology import MeshTopology, TopologyConfig  # noqa: F401
 from .runtime.config import DeepSpeedTPUConfig, load_config  # noqa: F401
 from .runtime.engine import DeepSpeedEngine, TrainState  # noqa: F401
+from .runtime import zero  # noqa: F401  (zero.Init / GatheredParameters)
+from .runtime import pipe  # noqa: F401  (PipelineModule / LayerSpec / PipelineEngine)
+from . import moe  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import monitor  # noqa: F401
+from . import ops  # noqa: F401
 
 
 def initialize(args=None,
@@ -83,3 +89,26 @@ def init_inference(model=None, config=None, **kwargs):
         raise NotImplementedError(
             "inference engine not available in this build") from e
     return InferenceEngine(model=model, config=config, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Update an argparse parser with the DeepSpeed argument group
+    (reference deepspeed/__init__.py:250): ``--deepspeed`` enable flag
+    and ``--deepspeed_config <json path>``."""
+    group = parser.add_argument_group(
+        "DeepSpeed", "DeepSpeed-TPU configurations")
+    group.add_argument(
+        "--deepspeed", default=False, action="store_true",
+        help="Enable DeepSpeed (helper flag for user code)")
+    group.add_argument(
+        "--deepspeed_config", default=None, type=str,
+        help="DeepSpeed json configuration file.")
+    return parser
+
+
+def default_inference_config():
+    """Default FastGen/v2 engine config as a plain dict (reference
+    deepspeed/__init__.py default_inference_config)."""
+    import dataclasses
+    from .inference.v2 import RaggedInferenceEngineConfig
+    return dataclasses.asdict(RaggedInferenceEngineConfig())

@@ -1,0 +1,5 @@
+"""MoE public API (reference ``deepspeed/moe/__init__.py``: the MoE
+layer + sharding utils)."""
+
+from . import capacity_bins, gating, layer  # noqa: F401
+from .layer import MoE, MoEConfig, init_moe_params, moe_forward  # noqa: F401

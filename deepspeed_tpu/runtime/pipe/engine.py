@@ -374,6 +374,15 @@ class PipelinedCausalLM:
         if moe_cfg is not None:
             from ...moe.layer import moe_forward
             training = is_training  # eval regime: eval_capacity_factor
+            if (getattr(moe_cfg, "noisy_gate_policy", None)
+                    and not getattr(self, "_gate_noise_warned", False)):
+                self._gate_noise_warned = True
+                log_dist(
+                    "PipelineEngine: noisy_gate_policy="
+                    f"{moe_cfg.noisy_gate_policy!r} is DISABLED under the "
+                    "pipeline (rng cannot enter the Manual-mode region); "
+                    "gating is deterministic top-k here",
+                    level=__import__("logging").WARNING)
 
             def mlp_fn(c, p, h):
                 return moe_forward(moe_cfg, p, h, is_training=training)

@@ -1,0 +1,69 @@
+"""ZeRO public API (reference ``deepspeed/runtime/zero/__init__.py``:
+``Init``, ``GatheredParameters`` + the partitioner internals).
+
+Under GSPMD the heavy machinery the reference exposes here is absorbed
+by sharding: parameters are BORN partitioned (the engine jits the
+initializer with sharded out_shardings), and gathering is a resharding.
+The two context managers stay as migration seams with those semantics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .partitioner import ZeroPartitioner  # noqa: F401
+
+
+@contextlib.contextmanager
+def Init(*args, **kwargs):
+    """Reference ``zero.Init`` (partition_parameters.py:880): construct
+    the model with parameters already partitioned so the full model
+    never materializes on one device.
+
+    Compatibility no-op: under GSPMD every ``initialize()`` already
+    jits parameter init with sharded out_shardings (engine
+    ``_init_params``), so there is nothing to enter — models are never
+    materialized unsharded in the first place."""
+    del args, kwargs
+    yield
+
+
+class GatheredParameters:
+    """Materialize full (host) copies of possibly-sharded params inside
+    a context (reference ``zero.GatheredParameters``,
+    partition_parameters.py:2283 — gather, optionally modify on one
+    rank, re-partition on exit).
+
+    Functional-params formulation: entering yields a NEW pytree of host
+    ``numpy`` arrays assembled from all shards; mutate those and write
+    them back yourself (params are immutable values here, so in-place
+    re-partition on exit has nothing to write into).
+    """
+
+    def __init__(self, params, modifier_rank=None, **kwargs):
+        del modifier_rank, kwargs
+        self.params = params
+
+    def __enter__(self):
+        import jax
+        import numpy as np
+        from flax.core import meta
+
+        def gather(x):
+            if isinstance(x, meta.Partitioned):
+                x = x.value
+            if isinstance(x, jax.Array):
+                if not x.is_fully_addressable:
+                    # multi-process: shards live on other hosts;
+                    # all-gather the global value across processes
+                    from jax.experimental import multihost_utils
+                    return np.asarray(
+                        multihost_utils.process_allgather(x, tiled=True))
+                return np.asarray(jax.device_get(x))
+            return x
+        return jax.tree.map(
+            gather, self.params,
+            is_leaf=lambda x: isinstance(x, meta.Partitioned))
+
+    def __exit__(self, *exc):
+        return False

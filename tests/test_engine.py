@@ -270,3 +270,26 @@ def test_reference_compat_accessors():
         raise AssertionError("inconsistent batch accepted")
     except ValueError:
         pass
+
+
+def test_checkpoint_resume_training_trajectory(tmp_path):
+    """Reference checkpoint_correctness_verification: the continued
+    TRAINING trajectory after load must match the uninterrupted one
+    step for step — this is what catches a dropped optimizer-moment or
+    loss-scale restore (params-only equality would still pass)."""
+    cfg = base_config(zero_optimization={"stage": 2},
+                      checkpoint={"async_save": False})
+    engine, _ = train_losses(cfg, steps=3)
+    engine.save_checkpoint(str(tmp_path), tag="mid")
+
+    engine2, _, _, _ = dst.initialize(model=SimpleModel(HIDDEN), config=cfg)
+    engine2.load_checkpoint(str(tmp_path))
+
+    global_bs = (engine.train_micro_batch_size_per_gpu()
+                 * engine.topology.batch_shard_size)
+    cont, resumed = [], []
+    for s in range(3):
+        batch = make_batch(global_bs, seed=100 + s)
+        cont.append(float(engine.train_batch(batch)))
+        resumed.append(float(engine2.train_batch(batch)))
+    np.testing.assert_allclose(resumed, cont, rtol=1e-6, atol=1e-7)

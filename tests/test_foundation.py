@@ -230,3 +230,40 @@ class TestCommBreadth:
                       mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"))
         f(x)
         assert "all_reduce" in dist.log_summary()
+
+
+class TestPublicAPI:
+    """Reference top-level API surface (deepspeed/__init__.py): zero
+    submodule, pipe/moe exports, argparse helper, default configs."""
+
+    def test_add_config_arguments(self):
+        import argparse
+        import deepspeed_tpu as dst
+        p = dst.add_config_arguments(argparse.ArgumentParser())
+        args = p.parse_args(["--deepspeed", "--deepspeed_config", "c.json"])
+        assert args.deepspeed and args.deepspeed_config == "c.json"
+        assert not p.parse_args([]).deepspeed
+
+    def test_default_inference_config(self):
+        import deepspeed_tpu as dst
+        cfg = dst.default_inference_config()
+        assert "kv_cache" in cfg and "quantization" in cfg
+
+    def test_zero_init_and_gathered_parameters(self):
+        import jax
+        import jax.numpy as jnp
+        import deepspeed_tpu as dst
+        with dst.zero.Init(config_dict_or_path=None):  # kwargs accepted
+            pass
+        tree = {"a": jnp.arange(4.0), "b": np.ones((2, 2))}
+        with dst.zero.GatheredParameters(tree, modifier_rank=0) as g:
+            assert isinstance(g["a"], np.ndarray)
+            np.testing.assert_array_equal(g["a"], np.arange(4.0))
+
+    def test_submodule_exports(self):
+        import deepspeed_tpu as dst
+        assert dst.pipe.PipelineModule is not None
+        assert dst.pipe.LayerSpec is not None
+        assert hasattr(dst.moe, "layer") or hasattr(dst.moe, "MoEConfig")
+        assert hasattr(dst.checkpoint, "engine")
+        assert dst.monitor is not None and dst.ops is not None
