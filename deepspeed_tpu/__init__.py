@@ -20,6 +20,14 @@ from . import moe  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import monitor  # noqa: F401
 from . import ops  # noqa: F401
+from . import module_inject  # noqa: F401
+from . import utils  # noqa: F401
+from .runtime.pipe.engine import PipelineEngine  # noqa: F401
+from .runtime.hybrid_engine import DeepSpeedHybridEngine  # noqa: F401
+from .runtime.lr_schedules import add_tuning_arguments  # noqa: F401
+from .inference.engine import InferenceEngine  # noqa: F401
+from .inference.engine import InferenceConfig as DeepSpeedInferenceConfig  # noqa: F401
+from .utils.logging import log_dist, logger  # noqa: F401
 
 
 def initialize(args=None,

@@ -165,3 +165,75 @@ class LRScheduler:
 
     def load_state_dict(self, sd):
         self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+def add_tuning_arguments(parser):
+    """Argparse group for convergence-tuning flags (reference
+    lr_schedules.py:61).  One flag per schedule parameter, derived from
+    the schedule functions' signatures so the CLI stays in lockstep
+    with the schedules themselves.  All flags default to None — only
+    explicitly-passed values reach the schedule config, so
+    get_lr_schedule's own defaulting (e.g. warmup_max_lr -> optimizer
+    base lr) still applies."""
+    import inspect
+
+    def str2bool(v):
+        if v.lower() in ("1", "true", "yes", "on"):
+            return True
+        if v.lower() in ("0", "false", "no", "off"):
+            return False
+        raise __import__("argparse").ArgumentTypeError(
+            f"expected a boolean, got {v!r}")
+
+    group = parser.add_argument_group(
+        "Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help=f"LR schedule for training "
+                            f"(one of {VALID_LR_SCHEDULES}).")
+    seen = set()
+    for fn in (lr_range_test, one_cycle, warmup_lr, warmup_decay_lr,
+               warmup_cosine_lr):
+        for name, p in inspect.signature(fn).parameters.items():
+            if name in seen or p.kind in (p.VAR_KEYWORD, p.VAR_POSITIONAL):
+                continue
+            seen.add(name)
+            import inspect as _i
+            ann = p.annotation
+            if ann is _i.Parameter.empty and \
+                    p.default is not _i.Parameter.empty \
+                    and p.default is not None:
+                ann = type(p.default)  # un-annotated: infer from default
+            if ann is bool:
+                argtype = str2bool
+            elif ann in (int, float, str):
+                argtype = ann
+            else:
+                argtype = float
+            group.add_argument(f"--{name}", type=argtype, default=None,
+                               help=f"{fn.__name__} parameter {name}.")
+    return parser
+
+
+def convert_lr_tuning_args(args):
+    """Parsed tuning args -> the scheduler config dict ``initialize``
+    consumes (reference get_lr_from_args flow).  Only explicitly-passed
+    flags enter params; schedules requiring total_num_steps raise a
+    clear error when the flag is missing."""
+    import inspect
+
+    sched = getattr(args, "lr_schedule", None)
+    if not sched:
+        return None
+    if sched not in VALID_LR_SCHEDULES:
+        raise ValueError(f"unknown lr_schedule {sched!r} "
+                         f"(valid: {VALID_LR_SCHEDULES})")
+    fn = _FACTORY[sched]
+    params = {}
+    for name, p in inspect.signature(fn).parameters.items():
+        if getattr(args, name, None) is not None:
+            params[name] = getattr(args, name)
+        elif p.default is inspect.Parameter.empty and \
+                p.kind not in (p.VAR_KEYWORD, p.VAR_POSITIONAL):
+            raise ValueError(
+                f"lr_schedule {sched} requires --{name}")
+    return {"type": sched, "params": params}

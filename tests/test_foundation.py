@@ -267,3 +267,28 @@ class TestPublicAPI:
         assert hasattr(dst.moe, "layer") or hasattr(dst.moe, "MoEConfig")
         assert hasattr(dst.checkpoint, "engine")
         assert dst.monitor is not None and dst.ops is not None
+
+    def test_engine_class_exports(self):
+        import deepspeed_tpu as dst
+        for name in ("PipelineEngine", "InferenceEngine",
+                     "DeepSpeedHybridEngine", "DeepSpeedInferenceConfig",
+                     "add_tuning_arguments", "log_dist", "logger",
+                     "module_inject", "utils"):
+            assert hasattr(dst, name), name
+
+    def test_lr_tuning_arguments_roundtrip(self):
+        import argparse
+        from deepspeed_tpu.runtime.lr_schedules import (
+            add_tuning_arguments, convert_lr_tuning_args, get_lr_schedule)
+        p = add_tuning_arguments(argparse.ArgumentParser())
+        args = p.parse_args(["--lr_schedule", "OneCycle",
+                             "--cycle_min_lr", "0.001",
+                             "--cycle_max_lr", "0.01"])
+        cfg = convert_lr_tuning_args(args)
+        assert cfg["type"] == "OneCycle"
+        sched = get_lr_schedule(cfg["type"], cfg["params"], 1e-3)
+        assert abs(float(sched(0)) - 0.001) < 1e-9
+        assert convert_lr_tuning_args(p.parse_args([])) is None
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            convert_lr_tuning_args(p.parse_args(["--lr_schedule", "bogus"]))
