@@ -27,24 +27,40 @@ REMAT_POLICY = os.environ.get("BENCH_REMAT", "save_attn_out")
 PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
 
-def _init_backend():
-    """Initialize the JAX backend with bounded retries.
+def _emit_error(stage, err):
+    """Print the one JSON artifact line for a failed run and exit 0.
 
-    A busy/held TPU chip raises ``UNAVAILABLE`` (or hangs briefly) on
-    backend init — exactly what killed BENCH_r03.  Retry a few times with
-    backoff, and on final failure emit a self-explaining JSON line instead
-    of a stack trace so the driver records a readable artifact.
+    The driver records stdout verbatim; a parseable error line beats a
+    traceback (BENCH_r03/r04 both recorded tracebacks because an
+    exception escaped before any JSON was printed)."""
+    print(json.dumps({
+        "metric": f"ERROR: {stage}",
+        "value": 0, "unit": "error",
+        "vs_baseline": 0,
+        "error": str(err)[:500],
+    }), flush=True)
+    sys.exit(0)
+
+
+def _init_backend():
+    """Initialize the JAX backend with a bounded, always-subprocess probe.
+
+    A busy/held TPU chip raises ``UNAVAILABLE`` — or HANGS — on first
+    backend touch.  ``import jax`` alone does NOT initialize a backend,
+    and the axon sitecustomize pre-imports jax in every process, so a
+    ``"jax" in sys.modules`` check says nothing about chip health (the
+    r4 failure: that fast path bypassed all of this machinery).  Always
+    probe in a killable child first; only then touch the backend here.
     """
     import subprocess
 
-    if "jax" in sys.modules:  # caller already configured a backend
-        import jax
-        return jax, jax.device_count()
-
-    retries = int(os.environ.get("BENCH_INIT_RETRIES", "6"))
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_INIT_BUDGET", "300"))
     delay = 15.0
+    attempt = 0
     last_err = "unknown"
-    for attempt in range(retries):
+    while time.monotonic() < deadline:
+        attempt += 1
         # Probe in a subprocess: JAX caches a failed backend init for the
         # life of the process, and a wedged chip can HANG init rather than
         # raise — a killable child covers both.
@@ -53,7 +69,8 @@ def _init_backend():
                 [sys.executable, "-c",
                  "import jax; print(jax.device_count())"],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-                timeout=120, start_new_session=True)
+                timeout=min(120, max(10, deadline - time.monotonic())),
+                start_new_session=True)
             if probe.returncode == 0:
                 try:
                     import jax
@@ -74,19 +91,13 @@ def _init_backend():
             else:
                 last_err = probe.stdout[-800:]
         except subprocess.TimeoutExpired:
-            last_err = "backend init hung >120s (chip held by another proc?)"
+            last_err = "backend init hung (chip held by another proc?)"
         sys.stderr.write(
-            f"bench: JAX backend probe failed (attempt {attempt + 1}/"
-            f"{retries}): {last_err}\n")
-        time.sleep(delay)
-        delay = min(delay * 2, 120.0)
-    print(json.dumps({
-        "metric": "ERROR: JAX backend init failed (TPU busy/unavailable?)",
-        "value": 0, "unit": "error",
-        "vs_baseline": 0,
-        "error": str(last_err)[:500],
-    }))
-    sys.exit(0)
+            f"bench: JAX backend probe failed (attempt {attempt}): "
+            f"{last_err}\n")
+        time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+        delay = min(delay * 2, 60.0)
+    _emit_error("JAX backend init failed (TPU busy/unavailable?)", last_err)
 
 
 def bench_fastgen(jax):
@@ -176,6 +187,15 @@ def bench_fastgen(jax):
 
 def main():
     jax, n_chips = _init_backend()
+    try:
+        _train_and_report(jax, n_chips)
+    except Exception as e:  # noqa: BLE001 — artifact must be a JSON line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        _emit_error("training bench failed", e)
+
+
+def _train_and_report(jax, n_chips):
     import deepspeed_tpu as dst
     from deepspeed_tpu.models.llama import LlamaForCausalLM
 
@@ -224,7 +244,7 @@ def main():
     del engine  # release training buffers before the inference leg
     if os.environ.get("BENCH_FASTGEN", "1") != "0":
         result.update(bench_fastgen(jax))
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
