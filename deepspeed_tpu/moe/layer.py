@@ -22,15 +22,18 @@ batched einsum hitting the MXU, never a python loop.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax.core import meta
 from jax.sharding import PartitionSpec as P
 
 from .gating import GateOutput, topk_gating
 from .capacity_bins import build_capacity_bins
+from ..parallel.topology import BATCH_AXES as BATCH
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,58 +91,94 @@ def _expert_act(cfg: MoEConfig, gate, up):
     return _activation(cfg, gate if "gated" in cfg.activation else None, up)
 
 
+# batch axes that stay on the token side of the dispatch all-to-all; the
+# 'expert' axis moves from sharding tokens to sharding experts
+_EP_TOKEN_AXES = tuple(a for a in BATCH if a != "expert")
+
+
 def moe_forward(cfg: MoEConfig, params, x: jax.Array,
                 rng: Optional[jax.Array] = None,
                 is_training: bool = True) -> Tuple[jax.Array, jax.Array]:
-    """x: [..., D] -> (out [..., D], aux_loss scalar)."""
+    """x: [..., D] -> (out [..., D], aux_loss scalar).
+
+    Grouped GShard formulation: tokens keep their leading batch dim as the
+    *group* dim G (one routing problem per group), so capacity, cumsum and
+    the one-hot position assignment are all group-local — no [T,*]
+    intermediate ever spans the batch sharding, which is what forced the
+    SPMD partitioner into involuntary full rematerialization in the
+    flat-token formulation (each [T,E,C] tensor went T-sharded-over-all ->
+    replicated).  The dispatched tensor's constraint moves the 'expert'
+    mesh axis from the token dim to the expert dim: XLA lowers that
+    transition as the all-to-all of reference ``sharded_moe.py:97``.
+    Capacity is per group, matching the reference's per-rank capacity
+    math (``_capacity`` over the local batch, sharded_moe.py:160).
+    """
     orig_shape = x.shape
     d = x.shape[-1]
-    xf = x.reshape(-1, d)
-    t = xf.shape[0]
+    if x.ndim >= 3:
+        g = int(np.prod(x.shape[:-2]))
+        s = x.shape[-2]
+    else:
+        g, s = 1, x.shape[0]
+    xg = x.reshape(g, s, d)
+    xg = _constrain(xg, BATCH, None, None)
     dtype = x.dtype
 
-    logits = jnp.einsum("td,de->te", xf, params["gate"].astype(dtype))
-    bins = build_capacity_bins(cfg, t) if cfg.num_capacity_bins > 0 else None
-    gate_out: GateOutput = topk_gating(
-        logits, cfg.top_k,
+    logits = jnp.einsum("gsd,de->gse", xg, params["gate"].astype(dtype))
+    bins = build_capacity_bins(cfg, s) if cfg.num_capacity_bins > 0 else None
+    gate_fn = functools.partial(
+        topk_gating, k=cfg.top_k,
         capacity_factor=(cfg.capacity_factor if is_training
                          else cfg.eval_capacity_factor),
         min_capacity=cfg.min_capacity,
         drop_tokens=cfg.drop_tokens,
         noisy_gate_policy=cfg.noisy_gate_policy if is_training else None,
-        rng=rng, capacity_bins=bins)
+        capacity_bins=bins)
+    if rng is not None:
+        gate_out: GateOutput = jax.vmap(
+            lambda lg, key: gate_fn(lg, rng=key))(
+                logits, jax.random.split(rng, g))
+    else:
+        gate_out = jax.vmap(gate_fn)(logits)
 
-    # dispatch: [T,E,C] x [T,D] -> [E,C,D], expert-sharded on dim 0
-    dispatched = jnp.einsum("tec,td->ecd",
-                            gate_out.dispatch_mask.astype(dtype), xf)
-    dispatched = _constrain(dispatched, "expert", None, None)
+    dispatch = _constrain(gate_out.dispatch_mask.astype(dtype),
+                          BATCH, None, None, None)     # [G,S,E,C]
+    combine = _constrain(gate_out.combine_weights.astype(dtype),
+                         BATCH, None, None, None)
+
+    # dispatch: [G,S,E,C] x [G,S,D] -> [G,E,C,D]; the constraint moves
+    # 'expert' from the G dim to the E dim (the EP all-to-all)
+    dispatched = jnp.einsum("gsec,gsd->gecd", dispatch, xg)
+    dispatched = _constrain(dispatched, _EP_TOKEN_AXES, "expert", None, None)
 
     # grouped expert FFN (stacked weights, batched einsum); _wval
     # dequantizes channel-quantized leaves lazily (weight-only inference)
     wi = _wval(params["wi"], dtype)
     wo = _wval(params["wo"], dtype)
-    up = jnp.einsum("ecd,edf->ecf", dispatched, wi)
-    gate_h = jnp.einsum("ecd,edf->ecf", dispatched, _wval(params["wg"], dtype)) \
-        if "wg" in params else None
+    up = jnp.einsum("gecd,edf->gecf", dispatched, wi)
+    gate_h = jnp.einsum("gecd,edf->gecf", dispatched,
+                        _wval(params["wg"], dtype)) if "wg" in params else None
     h = _expert_act(cfg, gate_h, up)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, wo)
-    expert_out = _constrain(expert_out, "expert", None, None)
+    expert_out = jnp.einsum("gecf,efd->gecd", h, wo)
+    expert_out = _constrain(expert_out, _EP_TOKEN_AXES, "expert", None, None)
 
-    # combine back to tokens
-    out = jnp.einsum("tec,ecd->td", gate_out.combine_weights.astype(dtype),
-                     expert_out)
+    # combine back to tokens (the return all-to-all: E gives 'expert'
+    # back to the token dim)
+    out = jnp.einsum("gsec,gecd->gsd", combine, expert_out)
+    out = _constrain(out, BATCH, None, None)
 
     if cfg.use_residual:
         # PR-MoE (reference moe/layer.py use_residual): dense FFN branch
         # (non-gated) mixed via a learned 2-way coefficient
         res_h = jax.nn.silu(jnp.einsum(
-            "td,df->tf", xf, params["res_wi"].astype(dtype)))
-        res = jnp.einsum("tf,fd->td", res_h, params["res_wo"].astype(dtype))
+            "gsd,df->gsf", xg, params["res_wi"].astype(dtype)))
+        res = jnp.einsum("gsf,fd->gsd", res_h, params["res_wo"].astype(dtype))
         coef = jax.nn.softmax(
-            jnp.einsum("td,dc->tc", xf, params["res_coef"].astype(dtype)), -1)
-        out = out * coef[:, :1] + res * coef[:, 1:]
+            jnp.einsum("gsd,dc->gsc", xg, params["res_coef"].astype(dtype)), -1)
+        out = out * coef[..., :1] + res * coef[..., 1:]
 
-    return out.reshape(orig_shape), gate_out.l_aux * cfg.aux_loss_coef
+    l_aux = jnp.mean(gate_out.l_aux) * cfg.aux_loss_coef
+    return out.reshape(orig_shape), l_aux
 
 
 class MoE:
