@@ -232,16 +232,34 @@ def monitored_barrier(timeout: float = 300.0) -> float:
     """Barrier with a wall-time watchdog (reference monitored_barrier,
     comm.py:375 — its gloo backend names the missing rank; XLA's
     collectives either complete or the runtime itself raises on a lost
-    host, so the useful signal here is the measured wait).  Returns the
-    barrier wall time in seconds; warns when it exceeds ``timeout``."""
+    host, so the useful signal here is the measured wait).  A watchdog
+    thread logs every ``timeout`` seconds while the barrier is blocked,
+    so a genuinely hung host is at least visible in this rank's log.
+    Returns the barrier wall time in seconds."""
+    import threading
     import time as _time
+
     t0 = _time.time()
-    barrier()
+    done = threading.Event()
+    interval = max(float(timeout), 1.0)  # non-positive would busy-spin
+
+    def watchdog():
+        while not done.wait(interval):
+            logger.warning(
+                "monitored_barrier: still blocked after %.1fs (timeout "
+                "%.1fs) — a host is hung, straggling, or the fabric is "
+                "congested", _time.time() - t0, timeout)
+
+    w = threading.Thread(target=watchdog, daemon=True)
+    w.start()
+    try:
+        barrier()
+    finally:
+        done.set()
     dt = _time.time() - t0
     if dt > timeout:
-        logger.warning(
-            "monitored_barrier: barrier took %.1fs (timeout %.1fs) — a "
-            "host is straggling or the fabric is congested", dt, timeout)
+        # the watchdog already warned while blocked; one closing info line
+        logger.info("monitored_barrier: barrier completed after %.1fs", dt)
     return dt
 
 
@@ -356,7 +374,16 @@ def new_group(axis_names: Sequence[str]):
     collective here takes that tuple directly as ``axis_name``."""
     if isinstance(axis_names, str):
         return (axis_names,)
-    return tuple(axis_names)
+    names = tuple(axis_names)
+    bad = [a for a in names if not isinstance(a, str)]
+    if bad:
+        raise ValueError(
+            f"new_group expects mesh-AXIS NAMES (strings), got {names!r}. "
+            "Reference-style rank lists (e.g. new_group([0, 1])) do not "
+            "translate to SPMD: a communicator here is a set of "
+            "jax.sharding.Mesh axes — pass e.g. new_group(['data']) or "
+            "new_group(['data', 'fsdp']) matching your MeshTopology.")
+    return names
 
 
 def get_world_group():

@@ -73,6 +73,11 @@ class TransformerConfig:
     scan_layers: bool = True
     remat: bool = True
     remat_policy: str = "nothing_saveable"
+    # reference activation_checkpointing.partition_activations
+    # (checkpointing.py:487): saved layer-boundary residuals are sharded
+    # along the sequence dim over the model-parallel axes, 1/(sp*tp)
+    # memory per device; XLA re-gathers at recompute
+    partition_activations: bool = False
     # auto: Pallas flash kernel whenever the mask is pure-causal (TPU;
     # jnp reference off-TPU) | flash: force | einsum: dense path
     attention_impl: str = "auto"
@@ -288,13 +293,13 @@ def flash_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v) -> jax.Ar
 
     mesh = _ambient_mesh()
     if mesh is not None:
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         batch_axes = tuple(a for a in BATCH if a in mesh.axis_names)
         head_axes = tuple(a for a in ("seq", "tensor") if a in mesh.axis_names)
         spec = P(batch_axes or None, head_axes or None, None, None)
         out = shard_map(per_shard, mesh=mesh,
                         in_specs=(spec, spec, spec), out_specs=spec,
-                        check_rep=False)(qf, kf, vf)
+                        check_vma=False)(qf, kf, vf)
     else:
         out = per_shard(qf, kf, vf)
     return out.transpose(0, 2, 1, 3)
@@ -489,6 +494,26 @@ _REMAT_POLICIES = {
 }
 
 
+def resolve_remat_policy(name: str):
+    """Remat-policy lookup incl. the host-offload variants backing the
+    reference's ``cpu_checkpointing`` (checkpointing.py:487): checkpoints
+    are saved to pinned host memory and fetched back for the backward,
+    trading HBM for PCIe/host traffic exactly like the CUDA path."""
+    if name in _REMAT_POLICIES:
+        return _REMAT_POLICIES[name]
+    if name == "offload_attn_out":
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["attn_out"],
+            offload_src="device", offload_dst="pinned_host")
+    if name == "offload_dots":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    raise ValueError(
+        f"unknown remat policy {name!r}; known: "
+        f"{sorted(_REMAT_POLICIES) + ['offload_attn_out', 'offload_dots']}")
+
+
 def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
             positions: Optional[jax.Array] = None,
             attention_mask: Optional[jax.Array] = None,
@@ -513,7 +538,14 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
             "positions, no attention_mask, and a mesh the head layout divides")
 
     if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        if cfg.pos_emb == "learned" and attention_mask is not None:
+            # padded batches: positions count only attended tokens
+            # (HF OPTLearnedPositionalEmbedding cumsum semantics — left
+            # or right padding yields the same logits as transformers)
+            am = attention_mask.astype(jnp.int32)
+            positions = jnp.clip(jnp.cumsum(am, axis=-1) - 1, 0)
+        else:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
     # Gather from an explicitly replicated table: the ZeRO JIT all-gather
     # of [V,E] happens once, the gather output is then born replicated and
@@ -557,26 +589,35 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
     body = functools.partial(_layer_body, cfg, mlp_fn=mlp_fn,
                              use_flash=use_flash, attn_bias=attn_bias)
 
+    # partition_activations: the layer-boundary residual (what the scan
+    # carry chain / checkpoint saves) is sharded along seq over the
+    # model-parallel axes — 1/(sp*tp) activation memory per device
+    part_axes = (_divisible_head_axes(s, ("seq", "tensor"))
+                 if cfg.partition_activations else ())
+
+    def bound(y):
+        return _constrain(y, BATCH, part_axes, None) if part_axes else y
+
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:
         def scan_body(carry, layer_params):
             x, aux_acc = carry
             y, aux = body(layer_params, x, sin, cos, mask)
-            return (y, aux_acc + aux), None
+            return (bound(y), aux_acc + aux), None
         if cfg.remat:
-            policy = _REMAT_POLICIES[cfg.remat_policy]
+            policy = resolve_remat_policy(cfg.remat_policy)
             scan_body = jax.checkpoint(scan_body, policy=policy,
                                        prevent_cse=False)
-        (x, aux_total), _ = jax.lax.scan(scan_body, (x, aux_total),
+        (x, aux_total), _ = jax.lax.scan(scan_body, (bound(x), aux_total),
                                          params["layers"])
     else:
         for i in range(cfg.num_layers):
             lp = params["layers"][f"layer_{i}"]
             fn = body
             if cfg.remat:
-                fn = jax.checkpoint(body, policy=_REMAT_POLICIES[cfg.remat_policy],
+                fn = jax.checkpoint(body, policy=resolve_remat_policy(cfg.remat_policy),
                                     prevent_cse=False)
-            x, aux = fn(lp, x, sin, cos, mask)
+            x, aux = fn(lp, bound(x), sin, cos, mask)
             aux_total = aux_total + aux
 
     x = _norm_apply(cfg, params["final_norm"], x)

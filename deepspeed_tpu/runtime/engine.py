@@ -252,6 +252,38 @@ class DeepSpeedEngine:
                      for k in ("scan_layers", "remat", "remat_policy",
                                "attention_impl")
                      if k in tpu.model_fields_set}
+        # reference activation_checkpointing block
+        # (runtime/activation_checkpointing/checkpointing.py:487)
+        ac = self.config.activation_checkpointing
+        if "policy" in ac.model_fields_set:
+            overrides["remat_policy"] = {
+                "full": "nothing_saveable",
+                "nothing": "everything_saveable",
+                "dots": "dots_saveable",
+                "dots_with_no_batch_dims":
+                    "dots_with_no_batch_dims_saveable",
+                "offload_dots": "offload_dots",
+            }.get(ac.policy, ac.policy)
+            overrides["remat"] = True
+        if ac.partition_activations:
+            overrides["partition_activations"] = True
+        if ac.cpu_checkpointing:
+            # host-offload the saved names of the active policy; policies
+            # that save nothing get the attn-out offload variant so the
+            # option has its documented memory effect
+            base = overrides.get("remat_policy", model.cfg.remat_policy)
+            if base == "everything_saveable":
+                raise ValueError(
+                    "cpu_checkpointing requires recomputation boundaries, "
+                    "but the active remat policy saves everything "
+                    "(policy='nothing' / everything_saveable).  Drop one "
+                    "of the two options.")
+            overrides["remat_policy"] = {
+                "save_attn_out": "offload_attn_out",
+                "dots_with_no_batch_dims_saveable": "offload_dots",
+                "dots_saveable": "offload_dots",
+            }.get(base, "offload_attn_out")
+            overrides["remat"] = True
         if self.config.sparse_gradients:
             # reference top-level key: embedding grads take the sparse
             # (indexed-slices) backward, runtime/sparse_tensor.py
@@ -574,6 +606,21 @@ class DeepSpeedEngine:
         # Batch shardings are rank-dependent per leaf, so the batch is
         # device_put with explicit shardings in train_batch and jit inherits
         # them (in_shardings left unspecified for that arg).
+        model_cfg = getattr(self.module, "cfg", None)
+        if str(getattr(model_cfg, "remat_policy", "")).startswith("offload_"):
+            # XLA workaround: explicit out_shardings + a host-offload remat
+            # policy makes jit annotate every result with a device
+            # placement custom-call that the SPMD partitioner rejects
+            # ("Side-effect HLO must have sharding", spmd_partitioner.cc).
+            # Enforce the state layout with in-function constraints instead.
+            def constrained_step(state, batch, rng):
+                new_state, metrics, off = step_fn(state, batch, rng)
+                new_state = jax.tree.map(
+                    lambda x, s: (jax.lax.with_sharding_constraint(x, s)
+                                  if isinstance(s, NamedSharding) else x),
+                    new_state, state_sh)
+                return new_state, metrics, off
+            return jax.jit(constrained_step, donate_argnums=donate)
         return jax.jit(step_fn,
                        out_shardings=(state_sh, None, None),
                        donate_argnums=donate)
@@ -672,6 +719,7 @@ class DeepSpeedEngine:
     def train_batch(self, batch=None, data_iter: Optional[Iterable] = None) -> float:
         """Run one full training step: gas micro-batches + optimizer update
         (reference PipelineEngine.train_batch / engine fwd+bwd+step cycle)."""
+        self._check_not_destroyed()
         if batch is None:
             source = data_iter if data_iter is not None else self.training_dataloader
             if source is None:
@@ -780,6 +828,7 @@ class DeepSpeedEngine:
     def forward(self, batch) -> float:
         """Buffer a micro-batch; returns its loss under current params
         (extra fwd — for exact-parity UX only; prefer train_batch)."""
+        self._check_not_destroyed()
         self._grad_acc_buffer.append(batch)
         with self.topology.mesh:
             placed = self._place_batch(batch, microbatched=False)
@@ -842,6 +891,7 @@ class DeepSpeedEngine:
             self._train_step = saved_step
 
     def eval_batch(self, batch) -> float:
+        self._check_not_destroyed()
         with self.topology.mesh:
             placed = self._place_batch(batch, microbatched=False)
             return float(self._eval_step(self.state, placed, self._next_rng()))
@@ -870,6 +920,7 @@ class DeepSpeedEngine:
     # --- checkpointing --------------------------------------------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True):
+        self._check_not_destroyed()
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({
@@ -891,6 +942,7 @@ class DeepSpeedEngine:
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
+        self._check_not_destroyed()
         tag = tag or self.checkpoint_engine.read_latest(load_dir)
         if tag is None:
             return None, {}
@@ -928,6 +980,7 @@ class DeepSpeedEngine:
                          filename: str = "model_weights.npz"):
         """Export consolidated bf16 weights for inference handoff
         (reference ``save_16bit_model`` engine.py:3620)."""
+        self._check_not_destroyed()
         from ..checkpoint.zero_to_fp32 import flatten_state_dict
         params = self.get_fp32_state_dict()
         flat = {k: v.astype(jnp.bfloat16)
@@ -975,6 +1028,13 @@ class DeepSpeedEngine:
         self._eval_step = None
         self._invalidate_step_caches()
         self.state = None
+        self._destroyed = True
+
+    def _check_not_destroyed(self):
+        if getattr(self, "_destroyed", False):
+            raise RuntimeError(
+                "engine destroyed: this DeepSpeedEngine was torn down by "
+                "destroy(); build a new engine with deepspeed_tpu.initialize")
 
     def compile(self, *a, **k):
         """Everything is already jitted by construction (SURVEY: compile
@@ -1030,6 +1090,7 @@ class DeepSpeedEngine:
         self._ga_boundary = None if is_boundary is None else bool(is_boundary)
 
     def dump_state(self):
+        self._check_not_destroyed()
         logger.info(
             "engine state: step=%s lr=%.3e loss_scale=%s skipped=%s "
             "zero_stage=%s mesh=%s", int(self.state.step), self.get_lr()[0],

@@ -193,16 +193,25 @@ def add_tuning_arguments(parser):
     seen = set()
     for fn in (lr_range_test, one_cycle, warmup_lr, warmup_decay_lr,
                warmup_cosine_lr):
-        for name, p in inspect.signature(fn).parameters.items():
+        # eval_str: under ``from __future__ import annotations`` every
+        # annotation is a string ("int | None"), which the type dispatch
+        # below would silently funnel to the float fallback
+        for name, p in inspect.signature(fn, eval_str=True).parameters.items():
             if name in seen or p.kind in (p.VAR_KEYWORD, p.VAR_POSITIONAL):
                 continue
             seen.add(name)
             import inspect as _i
+            import typing as _t
             ann = p.annotation
             if ann is _i.Parameter.empty and \
                     p.default is not _i.Parameter.empty \
                     and p.default is not None:
                 ann = type(p.default)  # un-annotated: infer from default
+            # Optional[int] / "int | None" annotations: the CLI type is
+            # the non-None member, not a float fallback
+            args = [a for a in _t.get_args(ann) if a is not type(None)]
+            if len(args) == 1:
+                ann = args[0]
             if ann is bool:
                 argtype = str2bool
             elif ann in (int, float, str):

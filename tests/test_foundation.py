@@ -221,6 +221,10 @@ class TestCommBreadth:
     def test_groups_and_host_plane(self):
         assert dist.new_group("data") == ("data",)
         assert dist.new_group(["data", "tensor"]) == ("data", "tensor")
+        # reference-style rank lists must fail loudly with migration help,
+        # not surface later as an obscure traced-collective axis error
+        with pytest.raises(ValueError, match="AXIS NAMES"):
+            dist.new_group([0, 1])
         dt = dist.monitored_barrier(timeout=60.0)
         assert dt >= 0.0
         dist.configure_comms_logger(enabled=True)
@@ -292,3 +296,13 @@ class TestPublicAPI:
         import pytest as _pytest
         with _pytest.raises(ValueError):
             convert_lr_tuning_args(p.parse_args(["--lr_schedule", "bogus"]))
+
+    def test_lr_tuning_optional_int_parses_as_int(self):
+        """Optional[int]-annotated one_cycle params must get an int CLI
+        type, not the float fallback (a float where the schedule expects
+        a step count breaks range arithmetic)."""
+        import argparse
+        from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
+        p = add_tuning_arguments(argparse.ArgumentParser())
+        args = p.parse_args(["--cycle_second_step_size", "700"])
+        assert isinstance(args.cycle_second_step_size, int)

@@ -170,3 +170,32 @@ def test_save_attn_out_remat_policy():
     assert abs(l_ref - l_new) < 1e-5
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), g_ref, g_new)
+
+
+def test_learned_positions_ignore_padding():
+    """Right-padded batch + attention_mask must produce the same logits
+    on real tokens as the unpadded run: learned positions are derived
+    from the mask (HF OPTLearnedPositionalEmbedding cumsum semantics),
+    not raw sequence offsets.  Also covers left padding, where arange
+    positions would be maximally wrong."""
+    model = GPTForCausalLM("debug", max_seq_len=32)
+    from flax.core import meta
+    params = meta.unbox(model.init_params(jax.random.key(0)))
+    rng = np.random.default_rng(3)
+    real = rng.integers(0, model.cfg.vocab_size, size=(1, 8)).astype(np.int32)
+
+    ref = np.asarray(forward(model.cfg, params, jnp.asarray(real)))
+
+    pad = np.zeros((1, 4), np.int32)
+    right = {"ids": np.concatenate([real, pad], 1),
+             "mask": np.concatenate([np.ones((1, 8)), np.zeros((1, 4))], 1),
+             "sel": slice(0, 8)}
+    left = {"ids": np.concatenate([pad, real], 1),
+            "mask": np.concatenate([np.zeros((1, 4)), np.ones((1, 8))], 1),
+            "sel": slice(4, 12)}
+    for case in (right, left):
+        out = np.asarray(forward(
+            model.cfg, params, jnp.asarray(case["ids"]),
+            attention_mask=jnp.asarray(case["mask"].astype(np.int32))))
+        np.testing.assert_allclose(out[0, case["sel"]], ref[0], atol=2e-2,
+                                   rtol=2e-2)
