@@ -89,6 +89,15 @@ def llama_config_from_hf(hf_cfg) -> TransformerConfig:
         norm="rmsnorm", norm_eps=hf_cfg.rms_norm_eps,
         activation="silu_gated", pos_emb="rope",
         rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        # Mistral/Mixtral sliding-window attention (HF sliding_window;
+        # reference inference/v2/model_implementations/mistral).  Qwen2
+        # ships sliding_window alongside use_sliding_window=false — only
+        # apply when the gate (absent on Mistral = on) says so.  Per-layer
+        # windows (Qwen2 max_window_layers) are not supported; all layers
+        # share one window.
+        sliding_window=(getattr(hf_cfg, "sliding_window", None)
+                        if getattr(hf_cfg, "use_sliding_window", True)
+                        else None),
         tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
         use_bias=False, dtype=jnp.bfloat16)
 

@@ -99,10 +99,12 @@ def _pallas_decode(cfg):
     (paged_attention auto-splits on Q)."""
     from ...ops.paged_attention import paged_attention
     slopes = _alibi_for(cfg)
+    window = getattr(cfg, "sliding_window", None)
 
     def attn(q, kv_layer, page_table, start_pos, q_lens):
         return paged_attention(q, kv_layer, page_table, start_pos, q_lens,
-                               use_kernel=None, alibi_slopes=slopes)
+                               use_kernel=None, alibi_slopes=slopes,
+                               window=window)
     return attn
 
 
@@ -111,10 +113,12 @@ def _dense_gather(cfg):
     """Pure-jnp paged attention (CPU / ground truth)."""
     from ...ops.paged_attention import paged_attention
     slopes = _alibi_for(cfg)
+    window = getattr(cfg, "sliding_window", None)
 
     def attn(q, kv_layer, page_table, start_pos, q_lens):
         return paged_attention(q, kv_layer, page_table, start_pos, q_lens,
-                               use_kernel=False, alibi_slopes=slopes)
+                               use_kernel=False, alibi_slopes=slopes,
+                               window=window)
     return attn
 
 

@@ -58,6 +58,14 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     rope_pct: float = 1.0              # partial rotary (GPT-NeoX/phi)
     causal: bool = True
+    # Mistral/Mixtral sliding-window attention (HF sliding_window): each
+    # position attends to the last `sliding_window` positions only.
+    # None = full causal.  Served by the flash kernel's banded block
+    # bounds on TPU and the dense mask on the einsum path; inference v2
+    # masks (and skips out-of-window pages in the decode kernel) — KV
+    # pages are still retained for the full context, so size num_pages
+    # for O(context), not O(window).
+    sliding_window: Optional[int] = None
     # attention-only biases (Qwen2: qkv bias, no o/mlp bias); use_bias
     # adds biases everywhere (GPT-2/NeoX style)
     qkv_bias: bool = False
@@ -289,7 +297,8 @@ def flash_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v) -> jax.Ar
             vs = jnp.repeat(vs, groups, axis=1)
         return flash_attention(qs, ks, vs, causal=True,
                                block_q=cfg.flash_block_q,
-                               block_k=cfg.flash_block_k)
+                               block_k=cfg.flash_block_k,
+                               window=cfg.sliding_window)
 
     mesh = _ambient_mesh()
     if mesh is not None:
@@ -572,6 +581,9 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
         mask = positions[:, :, None] >= positions[:, None, :]
     else:
         mask = jnp.ones((b, s, s), bool)
+    if mask is not None and cfg.sliding_window is not None:
+        mask = mask & ((positions[:, :, None] - positions[:, None, :])
+                       < cfg.sliding_window)
     if attention_mask is not None and mask is not None:
         mask = mask & attention_mask[:, None, :].astype(bool)
 

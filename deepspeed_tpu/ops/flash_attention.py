@@ -29,18 +29,44 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 
+def _band_keep(q_idx_base, k_idx_base, block_q, block_k, causal, window):
+    """Block-local keep mask for banded (causal / sliding-window)
+    attention: q attends k iff q_pos >= k_pos (causal) and
+    q_pos - k_pos < window (Mistral (t-window, t] semantics).  Shared by
+    all three kernels so the band definition cannot diverge."""
+    q_pos = q_idx_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_idx_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    keep = jnp.ones((block_q, block_k), bool)
+    if causal:
+        keep &= q_pos >= k_pos
+    if window is not None:
+        keep &= (q_pos - k_pos) < window
+    return keep
+
+
 # ---------------------------------------------------------------------------
 # reference (and CPU fallback)
 # ---------------------------------------------------------------------------
 
-def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
-    """[B,H,S,D] attention in fp32 softmax — semantics ground truth."""
+def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
+                  window: Optional[int] = None):
+    """[B,H,S,D] attention in fp32 softmax — semantics ground truth.
+    ``window``: sliding-window size incl. self (HF Mistral semantics:
+    position t attends to (t - window, t])."""
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(d)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    s_q, s_k = scores.shape[-2:]
+    mask = jnp.ones((s_q, s_k), bool)
     if causal:
-        s_q, s_k = scores.shape[-2:]
-        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        mask &= jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+    if window is not None:
+        q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)
+        k_pos = jnp.arange(s_k)[None, :]
+        mask &= (q_pos - k_pos) < window
+    if causal or window is not None:
         scores = jnp.where(mask, scores, DEFAULT_MASK_VALUE)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
@@ -51,7 +77,7 @@ def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_k, seq_k):
+                block_k, seq_k, window):
     q_idx = pl.program_id(2)
     block_q = q_ref.shape[0]
     d = q_ref.shape[1]
@@ -62,6 +88,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         # highest k block that intersects this q block's diagonal
         num_k = jnp.minimum(num_k, (q_idx + 1) * block_q // block_k
                             + ((q_idx + 1) * block_q % block_k != 0))
+    k_lo = jnp.int32(0)
+    if window is not None:
+        # first k block any row of this q block can see: row 0's window
+        # start is q_idx*block_q - window + 1 (blocks below it are fully
+        # masked and skipped — the flash win for long sliding-window seqs)
+        k_lo = jnp.maximum(
+            jnp.int32(0), (q_idx * block_q - window + 1) // block_k)
 
     m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -74,12 +107,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
-        if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        if causal or window is not None:
+            s = jnp.where(_band_keep(q_idx * block_q, ki * block_k, block_q,
+                                     block_k, causal, window),
+                          s, DEFAULT_MASK_VALUE)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
@@ -90,13 +121,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
-    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(k_lo, num_k, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l).astype(o_ref.dtype)
     lse_ref[:] = (m + jnp.log(l))[:, 0]
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret, window):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     block_q = min(block_q, s_q)
@@ -104,7 +135,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     grid = (b, h, pl.cdiv(s_q, block_q))
 
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_k=block_k, seq_k=s_k)
+                               block_k=block_k, seq_k=s_k, window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -131,7 +162,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, sm_scale, causal, block_q, seq_q):
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, seq_q,
+                    window):
     k_idx = pl.program_id(2)
     block_k = k_ref.shape[0]
     d = k_ref.shape[1]
@@ -142,6 +174,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q0 = jnp.int32(0)
     if causal:
         q0 = (k_idx * block_k) // block_q  # first q block on/under diagonal
+    if window is not None:
+        # last q that sees this k block: k_pos_max + window - 1
+        q_hi_pos = k_idx * block_k + block_k - 1 + window - 1
+        num_q = jnp.minimum(num_q, q_hi_pos // block_q + 1)
 
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
@@ -154,12 +190,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[pl.ds(qi * block_q, block_q)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        if causal or window is not None:
+            s = jnp.where(_band_keep(qi * block_q, k_idx * block_k, block_q,
+                                     block_k, causal, window),
+                          s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse[:, None])  # [bq, bk]
         dv = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -178,7 +212,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, sm_scale, causal, block_k, seq_k):
+                   dq_ref, *, sm_scale, causal, block_k, seq_k, window):
     q_idx = pl.program_id(2)
     block_q = q_ref.shape[0]
     d = q_ref.shape[1]
@@ -191,6 +225,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         num_k = jnp.minimum(num_k, (q_idx + 1) * block_q // block_k
                             + ((q_idx + 1) * block_q % block_k != 0))
+    k_lo = jnp.int32(0)
+    if window is not None:
+        k_lo = jnp.maximum(
+            jnp.int32(0), (q_idx * block_q - window + 1) // block_k)
 
     dq0 = jnp.zeros((block_q, d), jnp.float32)
 
@@ -199,12 +237,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        if causal or window is not None:
+            s = jnp.where(_band_keep(q_idx * block_q, ki * block_k, block_q,
+                                     block_k, causal, window),
+                          s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -213,11 +249,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, num_k, body, dq0)
+    dq = jax.lax.fori_loop(k_lo, num_k, body, dq0)
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret, window):
     q, k, v, out, lse = res
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
@@ -226,7 +262,8 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
-                                   causal=causal, block_q=block_q, seq_q=s_q)
+                                   causal=causal, block_q=block_q, seq_q=s_q,
+                                   window=window)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b, h, pl.cdiv(s_k, block_k)),
@@ -250,7 +287,8 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
     )(q, k, v, g, lse, delta)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
-                                  causal=causal, block_k=block_k, seq_k=s_k)
+                                  causal=causal, block_k=block_k, seq_k=s_k,
+                                  window=window)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, h, pl.cdiv(s_q, block_q)),
@@ -274,19 +312,25 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
 # public entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                     window):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                        window)
     return out
 
 
-def _flash_attention_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+def _flash_attention_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                         window):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                          window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_attention_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
-    return _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret)
+def _flash_attention_bwd(sm_scale, causal, block_q, block_k, interpret, window,
+                         res, g):
+    return _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret,
+                      window)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
@@ -297,7 +341,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     sm_scale: Optional[float] = None,
                     block_q: int = 512,
                     block_k: int = 512,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    window: Optional[int] = None) -> jax.Array:
     """Blockwise attention, [B,H,S,D].  GQA callers fold groups into H or
     repeat kv.  Falls back to the jnp reference off-TPU."""
     if sm_scale is None:
@@ -305,6 +350,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if interpret is None:
         backend = jax.default_backend()
         if backend != "tpu":
-            return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+            return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                                 window=window)
         interpret = False
-    return _flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return _flash_attention(q, k, v, sm_scale, causal, block_q, block_k,
+                            interpret, window)

@@ -71,6 +71,7 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
                     sm_scale: float | None = None,
                     use_kernel: Optional[bool] = None,
                     alibi_slopes: Optional[jax.Array] = None,
+                    window: Optional[int] = None,
                     interpret: bool = False) -> jax.Array:
     """Masked GQA attention of [S, Q] new tokens over their paged context.
 
@@ -92,7 +93,7 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
             return paged_decode_attention(
                 q, kv_layer, page_table, start_pos,
                 sm_scale=sm_scale, alibi_slopes=alibi_slopes,
-                interpret=interpret)
+                window=window, interpret=interpret)
     page_size = kv_layer.shape[1]
     K = kv_layer.shape[3]
     G = H // K
@@ -120,6 +121,8 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
     # page gather places context position c at row c of the flattened
     # pages exactly (pages are filled in order).
     mask = ctx[None, None, :] <= pos[:, :, None]            # [S, Q, C]
+    if window is not None:  # Mistral sliding window: (pos-window, pos]
+        mask &= ctx[None, None, :] > pos[:, :, None] - window
     # null-page / unallocated-page rows beyond the sequence never pass
     # the causal check since pos < allocated capacity * page_size.
     scores = jnp.where(mask[:, None, None, :, :], scores, MASK_VALUE)
@@ -133,7 +136,7 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
-                   sm_scale, has_alibi):
+                   sm_scale, has_alibi, window):
     """One (slot, kv_head, page) grid step of flash-style decode.
 
     q_ref : [G, D]         (this slot's queries for one kv head)
@@ -161,6 +164,11 @@ def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
 
     ctx_len = sp_ref[s] + 1  # new token at start_pos is already in cache
     page_valid = p * page_size < ctx_len
+    if window is not None:
+        # pages wholly below the window start contribute nothing: skip
+        # their DMA compute (the banded-decode analogue of the flash
+        # kernel's k_lo bound)
+        page_valid &= (p + 1) * page_size > ctx_len - window
 
     @pl.when(page_valid)
     def _attend():
@@ -174,7 +182,10 @@ def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
         if has_alibi:  # additive bias linear in the absolute key position
             scores = scores + (slopes_ref[0, :][:, None]
                                * ctx.astype(jnp.float32))
-        scores = jnp.where(ctx < ctx_len, scores, MASK_VALUE)
+        keep = ctx < ctx_len
+        if window is not None:
+            keep &= ctx >= ctx_len - window
+        scores = jnp.where(keep, scores, MASK_VALUE)
         m_prev = m_scr[:]                              # [G, 1]
         l_prev = l_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
@@ -196,6 +207,7 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
                            page_table: jax.Array, start_pos: jax.Array, *,
                            sm_scale: float | None = None,
                            alibi_slopes: Optional[jax.Array] = None,
+                           window: Optional[int] = None,
                            interpret: bool = False) -> jax.Array:
     """Pallas decode attention: Q=1 queries over paged KV.
 
@@ -238,7 +250,7 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
 
     kernel = functools.partial(
         _decode_kernel, page_size=page_size, num_pages_per_seq=P_pages,
-        sm_scale=scale, has_alibi=has_alibi)
+        sm_scale=scale, has_alibi=has_alibi, window=window)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -279,7 +291,8 @@ def gather_last(x: jax.Array, q_lens: jax.Array) -> jax.Array:
     return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
 
 
-def attention_reference(q, k_ctx, v_ctx, start_pos, q_lens) -> jax.Array:
+def attention_reference(q, k_ctx, v_ctx, start_pos, q_lens,
+                        window=None) -> jax.Array:
     """Dense ground-truth for tests: same masking over an unpaged
     [S, C, K, D] context."""
     S, Q, H, D = q.shape
@@ -290,6 +303,8 @@ def attention_reference(q, k_ctx, v_ctx, start_pos, q_lens) -> jax.Array:
     C = k_ctx.shape[1]
     pos = token_positions(start_pos, Q)
     mask = jnp.arange(C)[None, None, :] <= pos[:, :, None]
+    if window is not None:
+        mask &= jnp.arange(C)[None, None, :] > pos[:, :, None] - window
     scores = jnp.where(mask[:, None, None, :, :], scores, MASK_VALUE)
     probs = jax.nn.softmax(scores, axis=-1).astype(v_ctx.dtype)
     out = jnp.einsum("skgqc,sckd->sqkgd", probs, v_ctx)

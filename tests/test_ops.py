@@ -29,7 +29,7 @@ class TestFlashAttention:
         k = rand(1, 2, 128, 64, seed=2)
         v = rand(1, 2, 128, 64, seed=3)
         ref = mha_reference(q, k, v, causal=causal)
-        out = _flash_attention(q, k, v, 64 ** -0.5, causal, 64, 64, True)
+        out = _flash_attention(q, k, v, 64 ** -0.5, causal, 64, 64, True, None)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-3, rtol=2e-3)
 
@@ -39,7 +39,7 @@ class TestFlashAttention:
         v = rand(1, 1, 128, 32, seed=3)
 
         def loss_flash(q, k, v):
-            return _flash_attention(q, k, v, 32 ** -0.5, True, 64, 64, True).sum()
+            return _flash_attention(q, k, v, 32 ** -0.5, True, 64, 64, True, None).sum()
 
         def loss_ref(q, k, v):
             return mha_reference(q, k, v, causal=True).sum()
@@ -55,7 +55,7 @@ class TestFlashAttention:
         k = rand(1, 1, 96, 32, seed=2)
         v = rand(1, 1, 96, 32, seed=3)
         ref = mha_reference(q, k, v, causal=True)
-        out = _flash_attention(q, k, v, 32 ** -0.5, True, 64, 32, True)
+        out = _flash_attention(q, k, v, 32 ** -0.5, True, 64, 32, True, None)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-3, rtol=2e-3)
 
@@ -272,3 +272,60 @@ class TestFPQuantizer:
         q, s = fq.quantize(x, return_meta_tensor=True)
         with pytest.raises(ValueError):
             fq.dequantize(q)  # raw buffer without scale must fail loudly
+
+
+class TestSlidingWindow:
+    """Sliding-window attention (Mistral semantics: t attends (t-W, t])
+    across the reference, the Pallas kernels (interpret mode), fwd + bwd."""
+
+    def _qkv(self, s=128, d=32):
+        rng = np.random.default_rng(0)
+        return [jnp.asarray(rng.normal(size=(1, 2, s, d)).astype(np.float32))
+                for _ in range(3)]
+
+    def test_reference_masks_window(self):
+        from deepspeed_tpu.ops.flash_attention import mha_reference
+        q, k, v = self._qkv()
+        # W == S means no extra masking vs plain causal
+        full = mha_reference(q, k, v, causal=True)
+        same = mha_reference(q, k, v, causal=True, window=128)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(same),
+                                   atol=1e-6)
+        win = mha_reference(q, k, v, causal=True, window=16)
+        assert not np.allclose(np.asarray(full)[0, 0, -1],
+                               np.asarray(win)[0, 0, -1])
+        # position 10 sees <16 tokens: window inactive there
+        np.testing.assert_allclose(np.asarray(full)[0, :, 10],
+                                   np.asarray(win)[0, :, 10], atol=1e-6)
+
+    @pytest.mark.parametrize("window", [16, 48, 100])
+    def test_kernel_fwd_matches_reference(self, window):
+        from deepspeed_tpu.ops.flash_attention import (_flash_attention,
+                                                       mha_reference)
+        q, k, v = self._qkv()
+        ref = mha_reference(q, k, v, causal=True, window=window)
+        out = _flash_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1]), True,
+                               32, 32, True, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_kernel_bwd_matches_reference(self):
+        from deepspeed_tpu.ops.flash_attention import (_flash_attention,
+                                                       mha_reference)
+        q, k, v = self._qkv(s=64)
+        window = 24
+        sm = 1.0 / np.sqrt(q.shape[-1])
+
+        def loss_k(q, k, v):
+            return jnp.sum(_flash_attention(q, k, v, sm, True, 32, 32,
+                                            True, window) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=True,
+                                         window=window) ** 2)
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-4, rtol=3e-4)
