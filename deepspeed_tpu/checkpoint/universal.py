@@ -60,12 +60,33 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
     out_dir = out_dir or os.path.join(ckpt_dir, f"{tag}_{UNIVERSAL_DIR}")
     os.makedirs(out_dir, exist_ok=True)
 
+    # Pipeline checkpoints store layer-stacked leaves as [S, L/S, ...]
+    # (runtime/pipe/engine.py stack_stages).  Universal atoms must be
+    # topology-free, so merge the stage dim back into the layer dim —
+    # the analogue of the reference's pp-reshape in ds_to_universal.py
+    # (merge across pipeline ranks, :352).
+    pipe_stages = 1
+    cs_path = os.path.join(ckpt_dir, tag, "client_state.json")
+    client_state = None
+    if os.path.exists(cs_path):
+        with open(cs_path) as fh:
+            client_state = json.load(fh)
+        pipe_stages = int(client_state.get("pipe_stages", 1) or 1)
+
+    def unstack(key: str, arr: np.ndarray) -> np.ndarray:
+        if (pipe_stages > 1 and "/layers/" in f"/{key}/"
+                and arr.ndim >= 2 and arr.shape[0] == pipe_stages):
+            return arr.reshape((arr.shape[0] * arr.shape[1],)
+                               + arr.shape[2:])
+        return arr
+
     atoms: Dict[str, np.ndarray] = {}
     for key, arr in _flatten_with_paths(state["params"]).items():
+        arr = unstack(key, arr)
         atoms[f"params/{key}"] = arr.astype(np.float32) \
             if np.issubdtype(arr.dtype, np.floating) else arr
     for key, arr in _flatten_with_paths(state["opt_state"]).items():
-        atoms[f"opt_state/{key}"] = arr
+        atoms[f"opt_state/{key}"] = unstack(key, arr)
     np.savez(os.path.join(out_dir, ATOMS_FILE), **atoms)
 
     meta = {
@@ -76,10 +97,8 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
         "hysteresis": int(np.asarray(state["hysteresis"])),
         "source_tag": tag,
     }
-    cs_path = os.path.join(ckpt_dir, tag, "client_state.json")
-    if os.path.exists(cs_path):
-        with open(cs_path) as fh:
-            meta["client_state"] = json.load(fh)
+    if client_state is not None:
+        meta["client_state"] = client_state
     with open(os.path.join(out_dir, META_FILE), "w") as fh:
         json.dump(meta, fh)
     logger.info("universal checkpoint written: %s (%d atoms)",
@@ -114,11 +133,25 @@ def load_universal_into_engine(engine, universal_dir: str,
                 continue
             arr = atoms[key]
             if tuple(arr.shape) != tuple(leaf.shape):
-                raise ValueError(
-                    f"atom {key!r} shape {arr.shape} != current "
-                    f"{tuple(leaf.shape)} — universal atoms are global "
-                    f"(unsharded); a mismatch means a different MODEL, "
-                    f"not a different topology")
+                # loading INTO a pipeline engine: re-stack the layer dim
+                # [L, ...] -> [S, L/S, ...] (inverse of ds_to_universal's
+                # unstack; reference reshape_meg_2d pp re-split).  Gated
+                # on the engine actually being pipelined and a /layers/
+                # leaf so a different-MODEL shape coincidence still
+                # raises below.
+                stages = int(getattr(engine, "num_stages", 1) or 1)
+                if (stages > 1 and "/layers/" in f"/{key}/"
+                        and leaf.ndim == arr.ndim + 1
+                        and leaf.shape[0] == stages
+                        and leaf.shape[0] * leaf.shape[1] == arr.shape[0]
+                        and tuple(leaf.shape[2:]) == tuple(arr.shape[1:])):
+                    arr = arr.reshape(leaf.shape)
+                else:
+                    raise ValueError(
+                        f"atom {key!r} shape {arr.shape} != current "
+                        f"{tuple(leaf.shape)} — universal atoms are global "
+                        f"(unsharded); a mismatch means a different MODEL, "
+                        f"not a different topology")
             leaves.append(jax.device_put(arr.astype(leaf.dtype), leaf_sh))
         return jax.tree.unflatten(treedef, leaves)
 

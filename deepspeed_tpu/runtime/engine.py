@@ -928,6 +928,10 @@ class DeepSpeedEngine:
             "global_samples": self.global_samples,
             "micro_steps": self.micro_steps,
             "lr_scheduler": self.lr_scheduler.state_dict(),
+            # topology fingerprint for universal-checkpoint reshaping:
+            # pipeline params are stage-stacked [S, L/S, ...] on disk and
+            # ds_to_universal must unstack them into topology-free atoms
+            "pipe_stages": getattr(self, "num_stages", 1),
         })
         self.checkpoint_engine.save(save_dir, tag, self.state, client_state)
         if self.offload is not None:
