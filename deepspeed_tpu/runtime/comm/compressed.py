@@ -10,11 +10,17 @@ sign of the momentum update with per-worker error feedback.
 
 TPU-native shape: gradients are reduced by XLA collectives inside the
 jitted step, so the *math* of compression + error feedback is expressed as
-an optax transform over the (already sharded) gradient tree; wire-level
-quantized collectives (the EQuARX-style int8 psum path) live in
-``ops/quantization.py`` and kick in when ``zero_quantized_gradients`` is
-set.  State (momentum, frozen variance, error buffer) shards with the
-ZeRO partitioner like any optimizer state.
+an optax transform over the (already sharded) gradient tree.  The REAL
+wire compression lives in the engine's qgZ path: with
+``zero_optimization.zero_quantized_gradients`` on a batch-axes-only mesh,
+the whole backward runs in a shard_map region and the gradient reduction
+is ``ops.quantization.quantized_grad_reduce_shard`` — int8 hierarchical
+reduce-scatter over 'fsdp' + int8 allreduce over 'data'
+(engine._build_train_step; HLO-verified in tests/test_zeropp.py
+TestQgzWire).  This optimizer's sign-compression remains a numerics
+transform (the momentum tree it compresses is already ZeRO-sharded, so
+each rank touches only its shard).  State (momentum, frozen variance,
+error buffer) shards with the ZeRO partitioner like any optimizer state.
 """
 
 from __future__ import annotations

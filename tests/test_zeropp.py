@@ -126,3 +126,125 @@ def test_quantized_all_gather_st_grad():
         np.asarray(quantize_dequantize(x[i * 2:(i + 1) * 2]))
         for i in range(8)])
     np.testing.assert_allclose(np.asarray(g), 2 * ref, rtol=1e-5, atol=1e-5)
+
+
+class TestQgzWire:
+    """ZeRO++ qgZ real wire compression (reference
+    all_to_all_quant_reduce, runtime/comm/coalesced_collectives.py:31):
+    the gradient reduction must actually move int8 bytes, not just
+    reproduce quantization numerics."""
+
+    def _cfg(self, qgz, mesh):
+        return {
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2,
+                                  "zero_quantized_gradients": qgz},
+            "tpu": {"mesh": mesh},
+            "steps_per_print": 1000,
+        }
+
+    def test_training_converges_close_to_exact(self):
+        mesh = {"data": 2, "fsdp": 4}
+        exact, *_ = dst.initialize(model=SimpleModel(64),
+                                   config=self._cfg(False, mesh))
+        rng = np.random.default_rng(0)
+        bs = exact.train_batch_size()
+        batch = {"x": rng.normal(size=(bs, 64)).astype(np.float32),
+                 "y": rng.normal(size=(bs, 64)).astype(np.float32)}
+        ref = [float(exact.train_batch(batch)) for _ in range(6)]
+        q, *_ = dst.initialize(model=SimpleModel(64),
+                               config=self._cfg(True, mesh))
+        got = [float(q.train_batch(batch)) for _ in range(6)]
+        assert np.isfinite(got).all()
+        # quantized wire: close to exact but not bit-identical
+        np.testing.assert_allclose(got, ref, rtol=0.05)
+        assert got[-1] < got[0], "no learning through the int8 wire"
+        assert got != ref, "wire compression appears to be a no-op"
+
+    def test_hlo_moves_int8_collectives(self):
+        """Compiled step must contain all-to-all collectives on s8
+        operands, and the s8 collective bytes must dominate any fp32
+        gradient-sized collective traffic (the 4x wire-reduction claim)."""
+        import re
+        q, *_ = dst.initialize(model=SimpleModel(64),
+                               config=self._cfg(True,
+                                                {"data": 2, "fsdp": 4}))
+        rng = np.random.default_rng(0)
+        bs = q.train_batch_size()
+        batch = {"x": rng.normal(size=(bs, 64)).astype(np.float32),
+                 "y": rng.normal(size=(bs, 64)).astype(np.float32)}
+        gas = q.gradient_accumulation_steps()
+        shaped = {k: v.reshape((gas, bs // gas) + v.shape[1:])
+                  for k, v in batch.items()}
+        with q.topology.mesh:
+            placed = q._place_batch(shaped, microbatched=True)
+            txt = q._train_step.lower(
+                q.state, placed, q._next_rng()).compile().as_text()
+
+        def op_bytes(pattern):
+            total = 0
+            for shapes in re.findall(pattern, txt):
+                for dt, dims in re.findall(r"(s8|f32|bf16)\[([\d,]*)\]",
+                                           shapes):
+                    n = int(np.prod([int(d) for d in dims.split(",") if d])
+                            ) if dims else 1
+                    total += n * (1 if dt == "s8" else
+                                  2 if dt == "bf16" else 4)
+            return total
+
+        a2a_s8 = op_bytes(r"all-to-all[^\n]*?(\(.*?s8\[.*?\).*?)metadata")
+        assert "s8[" in txt and a2a_s8 > 0, \
+            "no int8 all-to-all in compiled HLO"
+        # the model has ~12k fp32 params; an exact wire would move
+        # >=4 bytes/elem in grad collectives. Count fp32 bytes through
+        # all-to-all/all-reduce-scatter ops and require the s8 payload
+        # to be the dominant gradient wire.
+        f32_coll = 0
+        for line in txt.splitlines():
+            if ("all-to-all" in line or "reduce-scatter" in line
+                    or "all-reduce" in line):
+                for dt, dims in re.findall(r"(f32)\[([\d,]+)\]", line):
+                    f32_coll += 4 * int(np.prod(
+                        [int(d) for d in dims.split(",") if d]))
+        n_params = sum(x.size for x in jax.tree.leaves(q.state.params))
+        # fp32 gradient-sized collectives must NOT appear (scales and
+        # the scalar loss pmean are orders of magnitude smaller)
+        assert f32_coll < 4 * n_params, (
+            f"fp32 collective bytes {f32_coll} >= uncompressed gradient "
+            f"wire {4 * n_params} — compression not on the wire")
+
+    def test_replicated_leaf_reduces_over_all_batch_axes(self):
+        """Regression: a grad leaf the partitioner left replicated
+        (shard_dim=None) must still be summed over BOTH the fsdp and
+        data axes — batch shards live on both.  Covers the small-leaf
+        exact-psum path, the int8 path, and the sharded-but-tiny path."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from deepspeed_tpu.ops.quantization import \
+            quantized_grad_reduce_shard
+        from deepspeed_tpu.parallel.topology import (MeshTopology,
+                                                     TopologyConfig)
+        topo = MeshTopology(TopologyConfig(data=2, fsdp=4))
+
+        def region(_):
+            r = (jax.lax.axis_index("data") * 4
+                 + jax.lax.axis_index("fsdp") + 1).astype(jnp.float32)
+            small = quantized_grad_reduce_shard(
+                jnp.full((8,), r), None)                    # exact psum
+            big = quantized_grad_reduce_shard(
+                jnp.full((1024,), r), None)                 # int8 wire
+            tiny_sharded = quantized_grad_reduce_shard(
+                jnp.full((8, 4), r), 0)                     # psum + slice
+            return small, big, tiny_sharded
+
+        small, big, tiny = shard_map(
+            region, mesh=topo.mesh,
+            in_specs=P(), out_specs=(P(), P(), P("fsdp", None)),
+            check_vma=False)(jnp.zeros(()))
+        total = float(sum(range(1, 9)))                     # 36
+        np.testing.assert_allclose(np.asarray(small), total)
+        np.testing.assert_allclose(np.asarray(big), total, rtol=0.02)
+        assert tiny.shape == (8, 4)
+        np.testing.assert_allclose(np.asarray(tiny), total)
