@@ -1,5 +1,7 @@
 from .flash_attention import flash_attention, mha_reference  # noqa: F401
-from .fused_optimizer import fused_adamw, fused_adamw_flat  # noqa: F401
+from .fused_optimizer import (fused_adamw, fused_adamw_flat,  # noqa: F401
+                              fused_lamb, fused_lamb_flat, fused_lion,
+                              fused_lion_flat)
 from .normalization import layernorm, rmsnorm  # noqa: F401
 from .quantization import (  # noqa: F401
     dequantize_blockwise,

@@ -84,6 +84,212 @@ def fused_adamw_flat(p: jax.Array, g: jax.Array, m: jax.Array, v: jax.Array,
     return out
 
 
+# ---------------------------------------------------------------------------
+# Lion (reference csrc/lion/fused_lion* + cpu_lion.cpp)
+# ---------------------------------------------------------------------------
+
+def _lion_kernel(p_ref, g_ref, m_ref, sc_ref, new_p_ref, new_m_ref):
+    """sign-momentum update: u = sign(b1*m + (1-b1)*g);
+    p -= lr*(u + wd*p); m = b2*m + (1-b2)*g.
+    sc_ref (SMEM, [4]): lr, b1, b2, wd."""
+    lr = sc_ref[0]
+    b1 = sc_ref[1]
+    b2 = sc_ref[2]
+    wd = sc_ref[3]
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    m = m_ref[:]
+    u = jnp.sign(b1 * m + (1.0 - b1) * g)
+    new_p_ref[:] = (p - lr * (u + wd * p)).astype(new_p_ref.dtype)
+    new_m_ref[:] = b2 * m + (1.0 - b2) * g
+
+
+def fused_lion_flat(p, g, m, lr, b1: float, b2: float, wd: float,
+                    block_rows: int = 256, interpret: bool | None = None):
+    """Apply fused Lion to flat 1-D buffers; returns (p, m)."""
+    n = p.shape[0]
+    pad = (-n) % _LANES
+    if pad:
+        p, g, m = (jnp.pad(x, (0, pad)) for x in (p, g, m))
+    rows = (n + pad) // _LANES
+    shape2 = (rows, _LANES)
+    p2, g2, m2 = (x.reshape(shape2) for x in (p, g, m))
+    scalars = jnp.asarray([lr, b1, b2, wd], jnp.float32)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_rows = min(block_rows, rows)
+    row_spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
+    new_p, new_m = pl.pallas_call(
+        _lion_kernel,
+        grid=(pl.cdiv(rows, block_rows),),
+        in_specs=[row_spec, row_spec, row_spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[row_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct(shape2, p.dtype),
+                   jax.ShapeDtypeStruct(shape2, jnp.float32)],
+        interpret=interpret,
+    )(p2, g2, m2, scalars)
+    out = (new_p.ravel(), new_m.ravel())
+    if pad:
+        out = tuple(x[:n] for x in out)
+    return out
+
+
+class FusedLionState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates
+
+
+def fused_lion(learning_rate, b1: float = 0.9, b2: float = 0.99,
+               weight_decay: float = 0.0) -> optax.GradientTransformation:
+    """optax transform running the Pallas Lion kernel per (raveled) leaf
+    — matches ``optax.lion`` numerics (decoupled decay)."""
+
+    def init_fn(params):
+        return FusedLionState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(lambda p: jnp.zeros(p.size, jnp.float32),
+                            params))
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("fused_lion requires params")
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        new_p, new_m = [], []
+        for p, g, m in zip(flat_p, flat_g, flat_m):
+            pf, mf = fused_lion_flat(
+                p.ravel().astype(jnp.float32),
+                g.ravel().astype(jnp.float32), m,
+                lr, b1, b2, weight_decay)
+            new_p.append(pf.reshape(p.shape).astype(p.dtype))
+            new_m.append(mf)
+        updates = jax.tree.unflatten(
+            treedef, [np_ - p for np_, p in zip(new_p, flat_p)])
+        return updates, FusedLionState(
+            count=count, mu=jax.tree.unflatten(treedef, new_m))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
+# LAMB (reference csrc/lamb/fused_lamb_cuda_kernel.cu: per-tensor trust
+# ratio over the Adam-style update)
+# ---------------------------------------------------------------------------
+
+def _lamb_stage1_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
+                        u_ref, new_m_ref, new_v_ref, norms_ref):
+    """Elementwise Adam-style update u (incl. decoupled wd term) + this
+    block's partial squared norms of p and u (norms_ref [1, 2] per grid
+    row; summed on the host side of the call).
+    sc_ref (SMEM, [5]): b1, b2, eps, wd, step."""
+    b1 = sc_ref[0]
+    b2 = sc_ref[1]
+    eps = sc_ref[2]
+    wd = sc_ref[3]
+    step = sc_ref[4]
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    bc1 = 1.0 - jnp.power(b1, step)
+    bc2 = 1.0 - jnp.power(b2, step)
+    u = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * p
+    u_ref[:] = u
+    new_m_ref[:] = m
+    new_v_ref[:] = v
+    norms_ref[0, 0] = jnp.sum(p * p)
+    norms_ref[0, 1] = jnp.sum(u * u)
+
+
+def fused_lamb_flat(p, g, m, v, lr, b1: float, b2: float, eps: float,
+                    wd: float, step, block_rows: int = 256,
+                    interpret: bool | None = None):
+    """Fused LAMB on flat 1-D buffers; returns (p, m, v).
+
+    Stage 1 (Pallas): moments + Adam-style update + per-block norm
+    partials in one elementwise pass.  The per-TENSOR trust ratio
+    ||p|| / ||u|| and the final axpy are O(1)+O(n) XLA ops fused into
+    the surrounding program (the CUDA version's second kernel)."""
+    n = p.shape[0]
+    pad = (-n) % _LANES
+    if pad:
+        p, g, m, v = (jnp.pad(x, (0, pad)) for x in (p, g, m, v))
+    rows = (n + pad) // _LANES
+    shape2 = (rows, _LANES)
+    p2, g2, m2, v2 = (x.reshape(shape2) for x in (p, g, m, v))
+    scalars = jnp.asarray([b1, b2, eps, wd, step], jnp.float32)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_rows = min(block_rows, rows)
+    nblocks = pl.cdiv(rows, block_rows)
+    row_spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
+    u, new_m, new_v, norms = pl.pallas_call(
+        _lamb_stage1_kernel,
+        grid=(nblocks,),
+        in_specs=[row_spec, row_spec, row_spec, row_spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[row_spec, row_spec, row_spec,
+                   pl.BlockSpec((1, 2), lambda i: (i, 0),
+                                memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct(shape2, jnp.float32),
+                   jax.ShapeDtypeStruct(shape2, jnp.float32),
+                   jax.ShapeDtypeStruct(shape2, jnp.float32),
+                   jax.ShapeDtypeStruct((nblocks, 2), jnp.float32)],
+        interpret=interpret,
+    )(p2, g2, m2, v2, scalars)
+    pn = jnp.sqrt(norms[:, 0].sum())
+    un = jnp.sqrt(norms[:, 1].sum())
+    ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+    new_p = (p2 - lr * ratio * u).astype(p.dtype)
+    out = (new_p.ravel(), new_m.ravel(), new_v.ravel())
+    if pad:
+        out = tuple(x[:n] for x in out)
+    return out
+
+
+def fused_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999,
+               eps: float = 1e-6, weight_decay: float = 0.0
+               ) -> optax.GradientTransformation:
+    """optax transform running the Pallas LAMB kernel per leaf (the
+    trust ratio is per PARAM TENSOR, reference FusedLamb semantics)."""
+
+    def init_fn(params):
+        z = jax.tree.map(lambda p: jnp.zeros(p.size, jnp.float32), params)
+        return FusedAdamState(count=jnp.zeros((), jnp.int32),
+                              mu=z, nu=jax.tree.map(jnp.zeros_like, z))
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("fused_lamb requires params")
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        new_p, new_m, new_v = [], [], []
+        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            pf, mf, vf = fused_lamb_flat(
+                p.ravel().astype(jnp.float32),
+                g.ravel().astype(jnp.float32), m, v,
+                lr, b1, b2, eps, weight_decay, count.astype(jnp.float32))
+            new_p.append(pf.reshape(p.shape).astype(p.dtype))
+            new_m.append(mf)
+            new_v.append(vf)
+        updates = jax.tree.unflatten(
+            treedef, [np_ - p for np_, p in zip(new_p, flat_p)])
+        return updates, FusedAdamState(
+            count=count,
+            mu=jax.tree.unflatten(treedef, new_m),
+            nu=jax.tree.unflatten(treedef, new_v))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 class FusedAdamState(NamedTuple):
     count: jax.Array
     mu: optax.Updates
