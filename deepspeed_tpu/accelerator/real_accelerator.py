@@ -26,10 +26,17 @@ def get_accelerator() -> DeepSpeedAccelerator:
     if name is not None and name not in SUPPORTED_ACCELERATOR_LIST:
         raise ValueError(
             f"DS_ACCELERATOR={name!r} not in {SUPPORTED_ACCELERATOR_LIST}")
+    import jax
+    backend = jax.default_backend()
     if name is None:
-        import jax
-        backend = jax.default_backend()
         name = "cpu" if backend == "cpu" else "tpu"
+    elif name == "tpu" and backend == "cpu":
+        # reference real_accelerator.py validates the requested device is
+        # actually importable/usable before committing to it
+        raise RuntimeError(
+            "DS_ACCELERATOR=tpu but the live JAX backend is 'cpu' — no "
+            "TPU is attached (or JAX_PLATFORMS forces cpu). Unset "
+            "DS_ACCELERATOR to auto-detect, or fix the TPU runtime.")
 
     if name == "tpu":
         from .tpu_accelerator import TPU_Accelerator

@@ -212,6 +212,17 @@ class Autotuner:
                  dp: int = 1):
         self.base_config = base_config
         self.num_params = num_params
+        if hbm_bytes is None:
+            # live HBM readback (reference see_memory_usage feeding the
+            # tuning-space heuristics, autotuner.py:278): when the device
+            # reports a real bytes_limit, use it as the pruning budget
+            # instead of flying blind
+            from ..utils.memory import device_memory_report
+            limit = device_memory_report().get("bytes_limit", 0)
+            if limit:
+                hbm_bytes = 0.9 * limit  # leave headroom for activations
+                logger.info("autotuner: using live HBM limit %.2f GB",
+                            hbm_bytes / 1024 ** 3)
         self.hbm_bytes = hbm_bytes
         self.stages = list(stages)
         self.micro_batches = list(micro_batches)

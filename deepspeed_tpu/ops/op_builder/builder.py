@@ -201,10 +201,51 @@ class AsyncIOBuilder(OpBuilder):
         lib.ds_aio_wait_all.restype = ctypes.c_int64
 
 
+class NotImplementedBuilder(OpBuilder):
+    """Stub for ops that are intentionally absent on TPU (reference
+    ``op_builder/hpu/no_impl.py`` — the registry stays honest about what
+    is out of scope instead of failing with a missing-name KeyError)."""
+    NAME = "no_impl"
+    SOURCES: List[str] = []
+    REASON = "not implemented on TPU"
+
+    def is_compatible(self) -> bool:
+        return False
+
+    def build(self):  # pragma: no cover - trivial
+        raise OpBuilderError(f"op {self.NAME!r}: {self.REASON}")
+
+    def load(self):
+        raise OpBuilderError(f"op {self.NAME!r}: {self.REASON}")
+
+
+class EvoformerAttnBuilder(NotImplementedBuilder):
+    """reference csrc/deepspeed4science/evoformer_attn (CUTLASS): out of
+    scope (SURVEY §2.5); AlphaFold-style workloads should use the flash
+    attention kernel over fused pair activations."""
+    NAME = "evoformer_attn"
+    REASON = ("DS4Science evoformer CUTLASS kernels are out of scope on "
+              "TPU; use ops.flash_attention over pair activations")
+
+
+class SparseAttnBuilder(NotImplementedBuilder):
+    """reference csrc/sparse_attention (triton-era remnant)."""
+    NAME = "sparse_attn"
+    REASON = ("legacy triton sparse attention is not ported; "
+              "sliding-window / ring attention cover the use cases")
+
+
+class SpatialInferenceBuilder(NotImplementedBuilder):
+    """reference csrc/spatial (diffusers bias-add helpers)."""
+    NAME = "spatial_inference"
+    REASON = "diffusers spatial kernels are not ported; XLA fuses bias-adds"
+
+
 ALL_OPS: Dict[str, Type[OpBuilder]] = {
     cls.NAME: cls
     for cls in (CPUAdamBuilder, CPUAdagradBuilder, CPULionBuilder,
-                AsyncIOBuilder)
+                AsyncIOBuilder, EvoformerAttnBuilder, SparseAttnBuilder,
+                SpatialInferenceBuilder)
 }
 
 

@@ -1,0 +1,53 @@
+"""Memory introspection (reference ``runtime/utils.py`` ``see_memory_usage``
+and ``accelerator/abstract_accelerator.py:116-165`` memory stats).
+
+``see_memory_usage`` snapshots live device HBM (via
+``jax.Device.memory_stats``) plus host RSS; ``device_memory_report``
+returns the raw numbers for programmatic use (the autotuner caps its
+analytic model with the real ``bytes_limit`` when a device is present).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .logging import log_dist, logger
+
+_GB = 1024 ** 3
+
+
+def device_memory_report(device_index: int = 0) -> Dict[str, int]:
+    """Live HBM stats of one local device: bytes_in_use, peak, limit.
+    Empty dict when the backend exposes no stats (CPU)."""
+    from ..accelerator import get_accelerator
+    return get_accelerator().memory_stats(device_index)
+
+
+def host_rss_bytes() -> int:
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # pragma: no cover - non-POSIX
+        return 0
+
+
+def see_memory_usage(message: str, force: bool = False,
+                     ranks=(0,)) -> Dict[str, float]:
+    """Log device + host memory around ``message`` (reference
+    ``see_memory_usage`` runtime/utils.py; used by the engine's
+    ``memory_breakdown`` and available to user scripts).  Returns the
+    numbers (GB) it printed."""
+    del force  # parity arg: reference gates on a global; we always report
+    dev = device_memory_report()
+    out = {
+        "device_in_use_gb": dev.get("bytes_in_use", 0) / _GB,
+        "device_peak_gb": dev.get("peak_bytes_in_use", 0) / _GB,
+        "device_limit_gb": dev.get("bytes_limit", 0) / _GB,
+        "host_rss_gb": host_rss_bytes() / _GB,
+    }
+    log_dist(
+        f"{message} | HBM in use {out['device_in_use_gb']:.2f}GB "
+        f"(peak {out['device_peak_gb']:.2f}GB / "
+        f"limit {out['device_limit_gb']:.2f}GB) | "
+        f"host RSS {out['host_rss_gb']:.2f}GB", ranks=list(ranks))
+    return out

@@ -306,3 +306,38 @@ class TestPublicAPI:
         p = add_tuning_arguments(argparse.ArgumentParser())
         args = p.parse_args(["--cycle_second_step_size", "700"])
         assert isinstance(args.cycle_second_step_size, int)
+
+
+class TestMemoryIntrospection:
+    def test_see_memory_usage_reports(self, caplog):
+        from deepspeed_tpu.utils import see_memory_usage
+        out = see_memory_usage("after test step")
+        assert set(out) == {"device_in_use_gb", "device_peak_gb",
+                            "device_limit_gb", "host_rss_gb"}
+        assert out["host_rss_gb"] > 0  # CPU accel reports RSS
+
+    def test_no_impl_builders_are_honest(self):
+        from deepspeed_tpu.ops.op_builder.builder import (ALL_OPS,
+                                                          OpBuilderError,
+                                                          get_op_builder)
+        for name in ("evoformer_attn", "sparse_attn", "spatial_inference"):
+            b = get_op_builder(name)()
+            assert not b.is_compatible()
+            with pytest.raises(OpBuilderError, match=name):
+                b.load()
+        assert "cpu_adam" in ALL_OPS
+
+    def test_ds_accelerator_tpu_rejected_on_cpu(self, monkeypatch):
+        from deepspeed_tpu.accelerator import real_accelerator
+        real_accelerator._accelerator = None
+        monkeypatch.setenv("DS_ACCELERATOR", "tpu")
+        with pytest.raises(RuntimeError, match="no "):
+            real_accelerator.get_accelerator()
+
+    def test_autotuner_uses_live_hbm_limit(self):
+        from deepspeed_tpu.autotuning.autotuner import Autotuner
+        # CPU backend reports no bytes_limit -> stays None (no pruning)
+        t = Autotuner(model_factory=lambda: None,
+                      data_fn=lambda bs: {}, base_config={},
+                      num_params=10 ** 6)
+        assert t.hbm_bytes is None or t.hbm_bytes > 0
