@@ -198,12 +198,15 @@ class Autotuner:
     data_fn: ``data_fn(global_batch_size) -> batch`` synthetic batch maker.
     base_config: DeepSpeed config dict; tuned keys are overridden.
     num_params: model parameter count (memory pruning).
-    hbm_bytes: per-chip device memory budget; None disables pruning.
+    hbm_bytes: per-chip device memory budget.  "auto" (default) reads
+        the live device bytes_limit when the backend reports one (90%
+        of it, leaving activation headroom); None disables pruning; a
+        number is used as-is.
     """
 
     def __init__(self, model_factory, data_fn, base_config: Dict[str, Any],
                  num_params: int = 0,
-                 hbm_bytes: Optional[float] = None,
+                 hbm_bytes="auto",
                  stages: Sequence[int] = (0, 1, 2, 3),
                  micro_batches: Sequence[int] = (1, 2, 4, 8),
                  tuner_type: str = "gridsearch",
@@ -212,13 +215,14 @@ class Autotuner:
                  dp: int = 1):
         self.base_config = base_config
         self.num_params = num_params
-        if hbm_bytes is None:
+        if hbm_bytes == "auto":
             # live HBM readback (reference see_memory_usage feeding the
             # tuning-space heuristics, autotuner.py:278): when the device
             # reports a real bytes_limit, use it as the pruning budget
             # instead of flying blind
             from ..utils.memory import device_memory_report
             limit = device_memory_report().get("bytes_limit", 0)
+            hbm_bytes = None
             if limit:
                 hbm_bytes = 0.9 * limit  # leave headroom for activations
                 logger.info("autotuner: using live HBM limit %.2f GB",

@@ -3,6 +3,8 @@ from .config import (KVCacheUserConfig, RaggedInferenceEngineConfig,
 from .engine import InferenceEngineV2, SchedulingError, SchedulingResult
 from .factory import build_hf_engine
 from .model import RaggedInferenceModel
+from .model_implementations import (implementation_for,
+                                    supported_model_types)
 from .ragged import (BlockedAllocator, BlockedKVCache, KVCacheConfig,
                      RaggedBatch, StateManager, build_batch)
 from .sampling import SamplingParams, sample
@@ -12,7 +14,8 @@ __all__ = [
     "KVCacheUserConfig", "RaggedInferenceEngineConfig", "StateManagerConfig",
     "InferenceEngineV2", "SchedulingError", "SchedulingResult",
     "build_hf_engine",
-    "RaggedInferenceModel", "BlockedAllocator", "BlockedKVCache",
+    "RaggedInferenceModel", "implementation_for", "supported_model_types",
+    "BlockedAllocator", "BlockedKVCache",
     "KVCacheConfig", "RaggedBatch", "StateManager", "build_batch",
     "SamplingParams", "sample", "FastGenScheduler", "Request", "generate",
 ]

@@ -14,8 +14,6 @@ import jax.numpy as jnp
 from ...checkpoint.hf import from_pretrained
 from .config import RaggedInferenceEngineConfig
 from .engine import InferenceEngineV2
-from .model import RaggedInferenceModel
-from .ragged import KVCacheConfig
 
 
 def build_hf_engine(model_or_path: Any,
@@ -23,10 +21,20 @@ def build_hf_engine(model_or_path: Any,
                     mesh: Optional[jax.sharding.Mesh] = None,
                     dtype=None) -> InferenceEngineV2:
     """Build a ragged inference engine from a transformers model instance
-    or a local HF checkpoint directory.  MoE architectures (mixtral)
-    carry their geometry on the TransformerConfig and the model
-    self-wires the routed mlp (reference resolves an arch policy here,
-    engine_factory.py:92)."""
-    cfg, params = from_pretrained(model_or_path, dtype=dtype or jnp.bfloat16)
-    model = RaggedInferenceModel(cfg, params, mesh=mesh)
+    or a local HF checkpoint directory.
+
+    Arch dispatch is two-stage, mirroring the reference engine_factory
+    (engine_factory.py:92): the injection-policy registry maps the
+    weights, then ``model_implementations.implementation_for`` picks the
+    per-arch model class that asserts the family's invariants (llama,
+    mistral, mixtral, falcon, opt, phi, qwen/qwen2, bloom, ...).  MoE
+    architectures carry their geometry on the TransformerConfig and the
+    model self-wires the routed mlp."""
+    from ...checkpoint.hf import load_hf_model
+    from .model_implementations import implementation_for
+
+    hf_model = load_hf_model(model_or_path)
+    cfg, params = from_pretrained(hf_model, dtype=dtype or jnp.bfloat16)
+    impl = implementation_for(hf_model.config.model_type)
+    model = impl(cfg, params, mesh=mesh)
     return InferenceEngineV2(model, engine_config)

@@ -1,0 +1,135 @@
+"""Per-architecture inference-v2 model implementations.
+
+Reference: ``inference/v2/model_implementations/`` — one directory per
+arch (llama_v2, mistral, mixtral, falcon, opt, phi, qwen, qwen_v2), each
+a ``DSTransformerModelBase`` subclass hard-coding that family's
+invariants (llama_v2/model.py:22, mistral/model.py, ...), chosen by
+``engine_factory`` from the checkpoint's ``model_type``.
+
+TPU-native shape: all families share ONE compiled core
+(:class:`~deepspeed_tpu.inference.v2.model.RaggedInferenceModel` over the
+functional transformer), so an "implementation" here is a thin subclass
+that (a) asserts the family's architectural invariants at construction —
+catching a mis-mapped checkpoint at build time the way the reference's
+per-arch containers would fail to bind weights — and (b) applies
+family-specific serving defaults.  ``implementation_for`` is the
+``model_type`` -> class chooser (reference engine_factory.py dispatch +
+modules/heuristics.py:36 ``instantiate_*``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple, Type
+
+from .model import RaggedInferenceModel
+
+
+class LlamaV2InferenceModel(RaggedInferenceModel):
+    """reference model_implementations/llama_v2/model.py:22."""
+    MODEL_TYPES: Tuple[str, ...] = ("llama",)
+
+    def __init__(self, cfg, params, **kw):
+        assert cfg.norm == "rmsnorm" and cfg.pos_emb == "rope", \
+            f"llama family expects rmsnorm+rope, got {cfg.norm}/{cfg.pos_emb}"
+        assert "gated" in cfg.activation, "llama family is gated-MLP"
+        super().__init__(cfg, params, **kw)
+
+
+class MistralInferenceModel(LlamaV2InferenceModel):
+    """reference model_implementations/mistral: llama shape + sliding
+    window.  HF mistral checkpoints ship sliding_window=4096 (or None on
+    later revisions — both are valid; when set, the paged decode kernel
+    skips out-of-window pages)."""
+    MODEL_TYPES = ("mistral",)
+
+
+class MixtralInferenceModel(RaggedInferenceModel):
+    """reference model_implementations/mixtral: mistral attention +
+    block-sparse MoE (the routed mlp self-wires from cfg.moe_num_experts;
+    serving uses dropless dispatch)."""
+    MODEL_TYPES = ("mixtral",)
+
+    def __init__(self, cfg, params, **kw):
+        assert cfg.moe_num_experts > 1, \
+            "mixtral checkpoint mapped without experts — wrong policy?"
+        super().__init__(cfg, params, **kw)
+
+
+class FalconInferenceModel(RaggedInferenceModel):
+    """reference model_implementations/falcon: parallel attention+MLP
+    residual for the new-decoder-architecture; the loader also supports
+    sequential-residual falcon variants (checkpoint/hf.py load_falcon),
+    so no residual-layout invariant is asserted here."""
+    MODEL_TYPES = ("falcon",)
+
+
+class OPTInferenceModel(RaggedInferenceModel):
+    """reference model_implementations/opt: learned positions (+2 HF
+    offset folded into the table at load), pre-LN, relu."""
+    MODEL_TYPES = ("opt",)
+
+    def __init__(self, cfg, params, **kw):
+        assert cfg.pos_emb == "learned", "OPT expects learned positions"
+        super().__init__(cfg, params, **kw)
+
+
+class PhiInferenceModel(RaggedInferenceModel):
+    """reference model_implementations/phi: partial rotary + parallel
+    residual (phi-2) / phi-3 llama-like."""
+    MODEL_TYPES = ("phi", "phi3")
+
+
+class Qwen2InferenceModel(RaggedInferenceModel):
+    """reference model_implementations/qwen_v2: llama geometry +
+    attention-only qkv biases (+ gated sliding window)."""
+    MODEL_TYPES = ("qwen2",)
+
+    def __init__(self, cfg, params, **kw):
+        assert cfg.qkv_bias, "qwen2 expects attention qkv biases"
+        super().__init__(cfg, params, **kw)
+
+
+class BloomInferenceModel(RaggedInferenceModel):
+    """bloom: ALiBi + embedding layernorm (beyond the reference's v2 set;
+    v1 kernel-injection covered it there)."""
+    MODEL_TYPES = ("bloom",)
+
+    def __init__(self, cfg, params, **kw):
+        assert cfg.pos_emb == "alibi", "bloom expects ALiBi"
+        super().__init__(cfg, params, **kw)
+
+
+class GPTNeoXInferenceModel(RaggedInferenceModel):
+    MODEL_TYPES = ("gpt_neox",)
+
+
+class GPT2InferenceModel(RaggedInferenceModel):
+    MODEL_TYPES = ("gpt2",)
+
+
+class GPTJInferenceModel(RaggedInferenceModel):
+    MODEL_TYPES = ("gptj",)
+
+
+_IMPLEMENTATIONS: Tuple[Type[RaggedInferenceModel], ...] = (
+    LlamaV2InferenceModel, MistralInferenceModel, MixtralInferenceModel,
+    FalconInferenceModel, OPTInferenceModel, PhiInferenceModel,
+    Qwen2InferenceModel, BloomInferenceModel,
+    GPTNeoXInferenceModel, GPT2InferenceModel, GPTJInferenceModel,
+)
+
+
+def implementation_for(model_type: str) -> Type[RaggedInferenceModel]:
+    """model_type -> implementation class (reference engine_factory
+    dispatch).  Unknown archs get the generic shared core — the policies
+    registry already validated the weight mapping."""
+    mt = model_type.lower()
+    for impl in _IMPLEMENTATIONS:
+        if mt in impl.MODEL_TYPES:
+            return impl
+    return RaggedInferenceModel
+
+
+def supported_model_types() -> Dict[str, str]:
+    return {t: impl.__name__ for impl in _IMPLEMENTATIONS
+            for t in impl.MODEL_TYPES}

@@ -1,3 +1,3 @@
 from .logging import log_dist, logger  # noqa: F401
-from .memory import (device_memory_report, host_rss_bytes,  # noqa: F401
-                     see_memory_usage)
+from .memory import (device_memory_report,  # noqa: F401
+                     host_peak_rss_bytes, see_memory_usage)

@@ -17,13 +17,16 @@ _GB = 1024 ** 3
 
 
 def device_memory_report(device_index: int = 0) -> Dict[str, int]:
-    """Live HBM stats of one local device: bytes_in_use, peak, limit.
-    Empty dict when the backend exposes no stats (CPU)."""
+    """Live device memory stats: bytes_in_use, peak, limit.  On CPU the
+    accelerator reports host peak RSS as bytes_in_use (no bytes_limit),
+    so autotuner pruning stays disabled there."""
     from ..accelerator import get_accelerator
     return get_accelerator().memory_stats(device_index)
 
 
-def host_rss_bytes() -> int:
+def host_peak_rss_bytes() -> int:
+    """Process-lifetime PEAK resident set size (ru_maxrss) — a
+    high-water mark, not current usage; it never decreases."""
     try:
         import resource
         return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
@@ -43,11 +46,12 @@ def see_memory_usage(message: str, force: bool = False,
         "device_in_use_gb": dev.get("bytes_in_use", 0) / _GB,
         "device_peak_gb": dev.get("peak_bytes_in_use", 0) / _GB,
         "device_limit_gb": dev.get("bytes_limit", 0) / _GB,
-        "host_rss_gb": host_rss_bytes() / _GB,
+        "host_peak_rss_gb": host_peak_rss_bytes() / _GB,
     }
     log_dist(
         f"{message} | HBM in use {out['device_in_use_gb']:.2f}GB "
         f"(peak {out['device_peak_gb']:.2f}GB / "
         f"limit {out['device_limit_gb']:.2f}GB) | "
-        f"host RSS {out['host_rss_gb']:.2f}GB", ranks=list(ranks))
+        f"host peak RSS {out['host_peak_rss_gb']:.2f}GB",
+        ranks=list(ranks))
     return out

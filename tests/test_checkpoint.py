@@ -502,3 +502,67 @@ class TestHFImportBloomGPTJ:
                 ref.append(nxt)
                 ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
         assert ours == ref, (ours, ref)
+
+
+def _tiny_hf_mistral(window=8):
+    import transformers
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        sliding_window=window, tie_word_embeddings=False,
+        attn_implementation="eager")
+    import torch
+    torch.manual_seed(0)
+    return transformers.MistralForCausalLM(cfg)
+
+
+class TestMistralParity:
+    def test_sliding_window_logits_match_hf(self):
+        """Mistral semantics proof: with a sequence 2.5x the sliding
+        window, our windowed attention must match transformers' eager
+        sliding-window mask logit for logit."""
+        import torch
+        hf = _tiny_hf_mistral(window=8).eval()
+        cfg, params = from_pretrained(hf, dtype=jnp.float32)
+        assert cfg.sliding_window == 8
+        ids = np.arange(1, 21, dtype=np.int32)[None, :] % 128
+        with torch.no_grad():
+            ref = hf(torch.tensor(np.asarray(ids), dtype=torch.long)
+                     ).logits.numpy()
+        cfg_f32 = dataclasses.replace(cfg, dtype=jnp.float32)
+        ours = np.asarray(forward(cfg_f32, params, ids))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+        # and the window genuinely matters at this length
+        no_win = dataclasses.replace(cfg_f32, sliding_window=None)
+        full = np.asarray(forward(no_win, params, ids))
+        assert not np.allclose(ours[0, -1], full[0, -1], atol=1e-4)
+
+    def test_factory_picks_arch_implementation(self):
+        from deepspeed_tpu.inference.v2 import build_hf_engine
+        from deepspeed_tpu.inference.v2.model_implementations import (
+            LlamaV2InferenceModel, MistralInferenceModel,
+            implementation_for, supported_model_types)
+        eng = build_hf_engine(_tiny_hf_mistral(), dtype=jnp.float32)
+        assert type(eng.model) is MistralInferenceModel
+        assert eng.model.cfg.sliding_window == 8
+        eng2 = build_hf_engine(_tiny_hf_llama(), dtype=jnp.float32)
+        assert type(eng2.model) is LlamaV2InferenceModel
+        assert implementation_for("unknown_arch").__name__ == \
+            "RaggedInferenceModel"
+        types = supported_model_types()
+        for t in ("llama", "mistral", "mixtral", "falcon", "opt", "phi",
+                  "qwen2", "bloom", "gpt_neox", "gpt2", "gptj"):
+            assert t in types, t
+
+    def test_arch_invariants_guard_mismapped_checkpoints(self):
+        from deepspeed_tpu.inference.v2.model_implementations import (
+            MixtralInferenceModel, Qwen2InferenceModel)
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        from flax.core import meta
+        m = LlamaForCausalLM("debug", max_seq_len=64)
+        params = meta.unbox(m.init_params(jax.random.key(0)))
+        with pytest.raises(AssertionError, match="experts"):
+            MixtralInferenceModel(m.cfg, params)
+        with pytest.raises(AssertionError, match="qkv bias"):
+            Qwen2InferenceModel(m.cfg, params)

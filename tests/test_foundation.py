@@ -313,8 +313,8 @@ class TestMemoryIntrospection:
         from deepspeed_tpu.utils import see_memory_usage
         out = see_memory_usage("after test step")
         assert set(out) == {"device_in_use_gb", "device_peak_gb",
-                            "device_limit_gb", "host_rss_gb"}
-        assert out["host_rss_gb"] > 0  # CPU accel reports RSS
+                            "device_limit_gb", "host_peak_rss_gb"}
+        assert out["host_peak_rss_gb"] > 0  # CPU accel reports RSS
 
     def test_no_impl_builders_are_honest(self):
         from deepspeed_tpu.ops.op_builder.builder import (ALL_OPS,
