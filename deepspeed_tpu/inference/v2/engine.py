@@ -81,6 +81,65 @@ class InferenceEngineV2:
             max_tracked_sequences=self._config.state_manager.max_tracked_sequences,
             kv_sharding=model.kv_sharding())
 
+    def precompile(self, max_prompt: int, max_concurrency: int = 0,
+                   max_new_tokens: int = 256,
+                   strict: bool = False) -> List[Tuple[int, int, int]]:
+        """AOT-compile the (S, Q, P) bucket lattice this engine can hit
+        (verdict on live serving: a first-use XLA compile is a TTFT
+        spike; the reference captures CUDA graphs at engine build).
+
+        S ranges over power-of-two slot counts up to ``max_concurrency``
+        (default: the state manager's max_ragged_sequence_count), Q over
+        {1} + power-of-two prompt buckets up to ``max_prompt``, P over
+        the page buckets needed for ``max_prompt`` + decode headroom.
+        Buckets whose S*Q exceeds max_ragged_batch_size are skipped (the
+        scheduler can never form them).  With ``strict``, any later
+        cache-miss bucket raises instead of compiling on the request
+        path.  Returns the compiled keys."""
+        import inspect
+
+        from .ragged.batch import _bucket, build_batch
+        sm = self._config.state_manager
+        max_concurrency = max_concurrency or sm.max_ragged_sequence_count
+        page = self._model.kv_config.page_size
+        # floors MUST mirror build_batch's defaults or the lattice misses
+        # the buckets the live path actually forms
+        bb = inspect.signature(build_batch).parameters
+        min_slots = bb["min_slots"].default
+        min_pages = bb["min_pages"].default
+
+        s_vals, q_vals, p_vals = [], [1], []
+        s = _bucket(1, min_slots)
+        while s <= _bucket(max_concurrency, min_slots):
+            s_vals.append(s)
+            s *= 2
+        q = 2
+        while q <= _bucket(max_prompt):
+            q_vals.append(q)
+            q *= 2
+        total = max_prompt + max_new_tokens  # decode growth headroom
+        max_pages_needed = _bucket(-(-total // page), min_pages)
+        p = _bucket(1, min_pages)
+        while p <= max_pages_needed:
+            p_vals.append(p)
+            p *= 2
+
+        kv = self._state.kv_cache.data
+        keys = []
+        for S in s_vals:
+            for Q in q_vals:
+                if S * Q > sm.max_ragged_batch_size:
+                    continue
+                for P in p_vals:
+                    if P * page < Q:  # bucket can't hold its own tokens
+                        continue
+                    key = (S, Q, P)
+                    self._model.precompile_step(key, kv)
+                    keys.append(key)
+        if strict:
+            self._model.strict_shapes = True
+        return keys
+
     @staticmethod
     def _free_device_memory() -> Optional[int]:
         """Free HBM on device 0, or None when the backend doesn't report

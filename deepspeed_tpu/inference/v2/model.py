@@ -173,9 +173,36 @@ class RaggedInferenceModel:
     def _get_step(self, key: Tuple[int, int, int]) -> Callable:
         fn = self._step_cache.get(key)
         if fn is None:
+            if getattr(self, "strict_shapes", False):
+                raise RuntimeError(
+                    f"batch bucket {key} (S, Q, P) was not precompiled — "
+                    "live serving would eat this XLA compile as a TTFT "
+                    "spike.  Widen InferenceEngineV2.precompile(...) or "
+                    "disable strict_shapes.")
             fn = jax.jit(self._step_impl, donate_argnums=(1,))
             self._step_cache[key] = fn
         return fn
+
+    def precompile_step(self, key: Tuple[int, int, int],
+                        kv_aval) -> None:
+        """AOT-compile one (S, Q, P) bucket (reference: FastGen's CUDA
+        graphs are captured at engine build; under XLA the analogue is
+        lower().compile() before serving so no bucket compiles on the
+        request path)."""
+        S, Q, P = key
+        if key in self._step_cache:
+            return
+        fn = jax.jit(self._step_impl, donate_argnums=(1,))
+        i32 = jnp.int32
+        # the COMPILED executable goes into the cache: later calls with
+        # the bucket's exact shapes dispatch straight to it (jit's own
+        # dispatch cache is not populated by AOT lowering)
+        self._step_cache[key] = fn.lower(
+            self.params, kv_aval,
+            jax.ShapeDtypeStruct((S, Q), i32),
+            jax.ShapeDtypeStruct((S,), i32),
+            jax.ShapeDtypeStruct((S,), i32),
+            jax.ShapeDtypeStruct((S, P), i32)).compile()
 
     def _step_impl(self, params, kv, token_ids, q_lens, start_pos,
                    page_table):

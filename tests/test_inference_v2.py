@@ -624,3 +624,24 @@ class TestSlidingWindowServing:
         for i in range(len(prompt) - 1, len(toks) - 1):
             ref_toks.append(int(np.argmax(ref_logits[i])))
         assert ref_toks == toks, (ref_toks[-6:], toks[-6:])
+
+
+class TestPrecompileLattice:
+    def test_precompile_covers_serving_and_strict_catches_misses(self):
+        eng, _, _ = _tiny_engine(num_pages=64, max_batch=256, max_seqs=4)
+        keys = eng.precompile(max_prompt=32, strict=True)
+        assert keys, "empty precompile lattice"
+        # every serving shape below the bounds must now dispatch without
+        # a fresh compile: run prefill + decode inside strict mode
+        rng = np.random.default_rng(0)
+        p1 = rng.integers(0, 100, 20)
+        p2 = rng.integers(0, 100, 5)
+        logits = eng.put([1, 2], [p1, p2])
+        assert logits.shape[0] == 2
+        eng.put([1], [np.array([7])])  # decode bucket
+        # a shape OUTSIDE the lattice raises instead of compiling
+        big = rng.integers(0, 100, 64)  # prompt > max_prompt bucket
+        with pytest.raises(RuntimeError, match="not precompiled"):
+            eng.put([3], [big])
+        eng.model.strict_shapes = False
+        eng.put([3], [big])  # and compiles fine when strictness is off
