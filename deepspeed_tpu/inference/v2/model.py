@@ -28,6 +28,58 @@ from ...ops.paged_attention import (gather_last, paged_attention,
 from .ragged import KVCacheConfig, RaggedBatch
 
 
+def _rebox_from_cfg(cfg: T.TransformerConfig, params):
+    """Attach logical-axis metadata to an UNBOXED param tree (HF imports
+    arrive as plain arrays) by zipping with the model family's own
+    abstract init — exact AutoTP classification with no name heuristics
+    (the reference's tp_parser walk, module_inject/auto_tp.py:283).
+    Leaves without a counterpart in the canonical tree (e.g. phi's
+    lm_head_bias) stay unboxed and therefore replicated."""
+    import jax
+
+    def ref_tree():
+        p = T.init_params(cfg, jax.random.key(0))
+        if cfg.moe_num_experts > 0:
+            from ...moe.layer import MoEConfig, init_moe_params
+            moe_cfg = MoEConfig(num_experts=cfg.moe_num_experts,
+                                top_k=cfg.moe_top_k,
+                                activation=cfg.activation)
+            one = init_moe_params(moe_cfg, cfg.hidden_size,
+                                  cfg.intermediate_size, jax.random.key(1))
+            if cfg.scan_layers:
+                p["layers"]["mlp"] = jax.tree.map(
+                    lambda x: T.meta.Partitioned(
+                        jax.numpy.broadcast_to(
+                            x.value, (cfg.num_layers,) + x.value.shape),
+                        names=("layers",) + x.names),
+                    one,
+                    is_leaf=lambda x: isinstance(x, T.meta.Partitioned))
+            else:
+                for i in range(cfg.num_layers):
+                    p["layers"][f"layer_{i}"]["mlp"] = one
+        return p
+
+    abstract = jax.eval_shape(ref_tree)
+    names: Dict[Tuple, Tuple] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            abstract,
+            is_leaf=lambda x: isinstance(x, T.meta.Partitioned))[0]:
+        if isinstance(leaf, T.meta.Partitioned):
+            key = tuple(getattr(p, "key", getattr(p, "idx", None))
+                        for p in path)
+            names[key] = tuple(leaf.names)
+
+    def box(path, leaf):
+        key = tuple(getattr(p, "key", getattr(p, "idx", None))
+                    for p in path)
+        nm = names.get(key)
+        if nm is not None and len(nm) == getattr(leaf, "ndim", -1):
+            return T.meta.Partitioned(leaf, names=nm)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(box, params)
+
+
 class RaggedInferenceModel:
     """Stateless compiled step over (params, kv, batch arrays)."""
 
@@ -63,6 +115,10 @@ class RaggedInferenceModel:
         self.kv_config = kv_config or KVCacheConfig(
             num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
             head_dim=cfg.dims_per_head, dtype=cfg.dtype)
+        if mesh is not None and not T._has_boxes(params):
+            # HF-imported trees are unboxed; recover the logical axes
+            # from the family's own init so AutoTP actually shards
+            params = _rebox_from_cfg(cfg, params)
         if mesh is not None and T._has_boxes(params):
             # TP sharding: heads/ffn/vocab over the 'tensor' mesh axis (the
             # AutoTP analogue — reference module_inject/auto_tp.py slices
