@@ -65,15 +65,25 @@ def _init_backend():
         # life of the process, and a wedged chip can HANG init rather than
         # raise — a killable child covers both.
         try:
+            # the axon sitecustomize overrides JAX_PLATFORMS at interpreter
+            # start; re-assert an explicit platform request in-config so a
+            # CPU-pinned run (tests/CI) never touches the chip
+            probe_code = (
+                "import os, jax\n"
+                "p = os.environ.get('JAX_PLATFORMS')\n"
+                "if p: jax.config.update('jax_platforms', p)\n"
+                "print(jax.device_count())\n")
             probe = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.device_count())"],
+                [sys.executable, "-c", probe_code],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
                 timeout=min(120, max(10, deadline - time.monotonic())),
                 start_new_session=True)
             if probe.returncode == 0:
                 try:
                     import jax
+                    plat = os.environ.get("JAX_PLATFORMS")
+                    if plat:  # beat the sitecustomize override (see probe)
+                        jax.config.update("jax_platforms", plat.split(",")[0])
                     return jax, jax.device_count()
                 except RuntimeError as e:
                     # chip re-wedged between probe and parent init (a
@@ -192,6 +202,8 @@ def bench_fastgen(jax):
 
 
 def main():
+    if os.environ.get("BENCH_SWEEP"):
+        return _sweep()  # parent never touches the chip: children own it
     jax, n_chips = _init_backend()
     try:
         _train_and_report(jax, n_chips)
@@ -199,6 +211,63 @@ def main():
         import traceback
         traceback.print_exc(file=sys.stderr)
         _emit_error("training bench failed", e)
+
+
+def _sweep():
+    """MFU sweep: try remat policy x micro-batch x model size with short
+    runs, each in its own SUBPROCESS (a config that OOMs must not kill
+    the sweep, and only one process may hold the chip at a time — the
+    parent never initializes a backend), then rerun the winner fully and
+    pass its JSON line through as THE artifact."""
+    import subprocess
+
+    def run_child(env_over, steps, fastgen, timeout):
+        env = dict(os.environ)
+        env.update(env_over)
+        env.update(BENCH_STEPS=steps, BENCH_FASTGEN=fastgen, BENCH_SWEEP="")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
+            text=True, timeout=timeout, start_new_session=True)
+        lines = proc.stdout.strip().splitlines()
+        return json.loads(lines[-1]) if lines else {}
+
+    grid = []
+    for model in os.environ.get("BENCH_SWEEP_MODELS", "1b,2b").split(","):
+        for mbs in os.environ.get("BENCH_SWEEP_BS", "4,8,16").split(","):
+            for remat in os.environ.get(
+                    "BENCH_SWEEP_REMAT",
+                    "save_attn_out,dots_with_no_batch_dims_saveable").split(","):
+                grid.append((model.strip(), mbs.strip(), remat.strip()))
+    results = []
+    for model, mbs, remat in grid:
+        try:
+            r = run_child({"BENCH_MODEL": model, "BENCH_BS": mbs,
+                           "BENCH_REMAT": remat}, steps="3", fastgen="0",
+                          timeout=float(os.environ.get(
+                              "BENCH_SWEEP_TIMEOUT", "420")))
+            if r.get("unit") == "tokens/s/chip":
+                results.append((r["vs_baseline"], model, mbs, remat))
+                sys.stderr.write(
+                    f"sweep: {model} bs={mbs} {remat}: "
+                    f"{r['value']} tok/s MFU={r['vs_baseline']}\n")
+            else:
+                sys.stderr.write(
+                    f"sweep: {model} bs={mbs} {remat}: {r}\n")
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"sweep: {model} bs={mbs} {remat} failed: {e}\n")
+    if not results:
+        _emit_error("sweep produced no successful configs", "all failed")
+    results.sort(reverse=True)
+    _, model, mbs, remat = results[0]
+    sys.stderr.write(f"sweep winner: {model} bs={mbs} {remat}; full run\n")
+    final = run_child({"BENCH_MODEL": model, "BENCH_BS": mbs,
+                       "BENCH_REMAT": remat},
+                      steps=os.environ.get("BENCH_STEPS", "10"),
+                      fastgen=os.environ.get("BENCH_FASTGEN", "1"),
+                      timeout=1800)
+    final["swept_configs"] = len(grid)
+    print(json.dumps(final), flush=True)
 
 
 def _train_and_report(jax, n_chips):
