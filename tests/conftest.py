@@ -46,3 +46,16 @@ def _reset_accelerator():
     from deepspeed_tpu.accelerator import real_accelerator
     real_accelerator._accelerator = None
     yield
+
+
+def pytest_collection_modifyitems(config, items):
+    """Apply the central heavy-marker table (reference
+    tests/unit/ci_promote_marker.py pattern: per-tier markers maintained
+    centrally, test bodies untouched)."""
+    from heavy_marker import HEAVY_TESTS
+    import pathlib
+    root = pathlib.Path(str(config.rootdir))
+    for item in items:
+        rel = item.nodeid
+        if rel in HEAVY_TESTS:
+            item.add_marker(pytest.mark.heavy)

@@ -1,0 +1,121 @@
+"""Central heavy-test marker table (the HPU fork's marker-table pattern,
+reference tests/unit/ci_promote_marker.py — per-tier status tracked
+centrally, test bodies untouched).
+
+Tests listed here get ``@pytest.mark.heavy`` at collection time
+(tests/conftest.py) and are EXCLUDED from the default run, keeping the
+default tier under ~3 minutes.  Run everything with::
+
+    pytest tests/ -m "heavy or not heavy"
+
+The list was generated from a measured full run (--durations): every
+test whose call took >= 4s.  When adding a slow test (engine
+construction, HF parity, multi-second compiles), add it here.
+Durations in comments are from the generating run (8-dev CPU mesh).
+"""
+
+HEAVY_TESTS = frozenset([
+    "tests/test_autotuning.py::test_end_to_end_tune_picks_best",  # 7.01s
+    "tests/test_checkpoint.py::TestHFImport::test_build_hf_engine_generates",  # 7.78s
+    "tests/test_checkpoint.py::TestHFImport::test_llama_logits_parity",  # 15.90s
+    "tests/test_checkpoint.py::TestHFImportBloomGPTJ::test_bloom_v2_greedy_matches_hf",  # 6.25s
+    "tests/test_checkpoint.py::TestHFImportBloomGPTJ::test_generate_smoke[_tiny_hf_bloom]",  # 6.20s
+    "tests/test_checkpoint.py::TestHFImportBloomGPTJ::test_generate_smoke[_tiny_hf_gptj]",  # 6.11s
+    "tests/test_checkpoint.py::TestHFImportBreadth::test_generate_smoke[_tiny_hf_mixtral]",  # 7.42s
+    "tests/test_checkpoint.py::TestHFImportBreadth::test_generate_smoke[_tiny_hf_neox]",  # 6.04s
+    "tests/test_checkpoint.py::TestHFImportBreadth::test_generate_smoke[_tiny_hf_qwen2]",  # 5.97s
+    "tests/test_checkpoint.py::TestHFImportBreadth::test_mixtral_v1_init_inference_generates",  # 10.35s
+    "tests/test_checkpoint.py::TestHFImportBreadthFalconOptPhi::test_generate_smoke[_tiny_hf_phi3]",  # 5.71s
+    "tests/test_checkpoint.py::TestHFImportBreadthFalconOptPhi::test_generate_smoke[_tiny_hf_phi]",  # 6.17s
+    "tests/test_checkpoint.py::TestHFImportBreadthFalconOptPhi::test_phi_v2_engine_applies_lm_head_bias",  # 6.24s
+    "tests/test_checkpoint.py::TestMistralParity::test_arch_invariants_guard_mismapped_checkpoints",  # 7.54s
+    "tests/test_checkpoint.py::TestTopologyReshape::test_reshape_roundtrip[save_mesh0-load_mesh0]",  # 6.06s
+    "tests/test_compression.py::test_engine_integration_prunes_params",  # 4.27s
+    "tests/test_engine.py::TestActivationCheckpointing::test_cpu_checkpointing_offloads_and_trains",  # 24.51s
+    "tests/test_engine.py::TestActivationCheckpointing::test_partition_activations_trains_on_mp_mesh",  # 23.93s
+    "tests/test_engine.py::TestActivationCheckpointing::test_policy_name_mapping",  # 26.31s
+    "tests/test_engine.py::test_checkpoint_reshard_topology",  # 4.73s
+    "tests/test_engine.py::test_checkpoint_resume_training_trajectory",  # 5.96s
+    "tests/test_engine.py::test_checkpoint_save_load_roundtrip",  # 5.55s
+    "tests/test_engine.py::test_reference_compat_accessors",  # 4.08s
+    "tests/test_engine.py::test_zero_stages_converge[0]",  # 4.39s
+    "tests/test_engine.py::test_zero_stages_match_numerically",  # 12.65s
+    "tests/test_inference_v1.py::test_hybrid_engine_train_and_generate",  # 23.83s
+    "tests/test_inference_v1.py::test_init_inference_generate_and_forward",  # 9.00s
+    "tests/test_inference_v2.py::TestEndToEnd::test_chunked_prefill_then_decode_matches_full",  # 5.95s
+    "tests/test_inference_v2.py::TestEndToEnd::test_generate_matches_engine_greedy",  # 20.82s
+    "tests/test_inference_v2.py::TestPrecompileLattice::test_precompile_covers_serving_and_strict_catches_misses",  # 147.61s
+    "tests/test_inference_v2.py::TestQuantizedInference::test_quantized_generate_close_to_full_precision[fp8_e4m3]",  # 19.42s
+    "tests/test_inference_v2.py::TestQuantizedInference::test_quantized_generate_close_to_full_precision[int8]",  # 11.40s
+    "tests/test_inference_v2.py::TestQuantizedInference::test_quantized_moe_generates",  # 14.32s
+    "tests/test_inference_v2.py::TestScheduler::test_mixed_sampling_params_respected",  # 10.55s
+    "tests/test_inference_v2.py::TestSlidingWindowServing::test_ragged_model_matches_core_forward",  # 9.32s
+    "tests/test_inference_v2.py::TestTensorParallelInference::test_tp_sharded_matches_single_device",  # 7.15s
+    "tests/test_launcher_elasticity.py::test_launch_propagates_child_failure",  # 23.23s
+    "tests/test_launcher_elasticity.py::test_launch_runs_script_per_rank",  # 22.38s
+    "tests/test_lora_universal.py::test_lora_adapter_changes_output_and_merge",  # 4.05s
+    "tests/test_lora_universal.py::test_universal_pipe_tp_to_fsdp_bitwise",  # 80.73s
+    "tests/test_lora_universal.py::test_universal_roundtrip_across_topologies",  # 10.22s
+    "tests/test_lora_universal.py::test_universal_strict_missing_atom",  # 7.60s
+    "tests/test_models.py::TestForward::test_bert_not_causal",  # 8.93s
+    "tests/test_models.py::TestForward::test_causal_masking",  # 5.70s
+    "tests/test_models.py::TestForward::test_llama_logits_shape",  # 6.01s
+    "tests/test_models.py::TestForward::test_scan_matches_unrolled",  # 14.00s
+    "tests/test_models.py::TestTraining::test_bert_mlm_trains",  # 16.58s
+    "tests/test_models.py::TestTraining::test_gpt_trains",  # 13.37s
+    "tests/test_models.py::TestTraining::test_llama_tp_sp_mesh",  # 45.41s
+    "tests/test_models.py::TestTraining::test_llama_zero_trains[0]",  # 27.53s
+    "tests/test_models.py::TestTraining::test_llama_zero_trains[3]",  # 32.38s
+    "tests/test_models.py::test_learned_positions_ignore_padding",  # 5.97s
+    "tests/test_models.py::test_save_attn_out_remat_policy",  # 16.46s
+    "tests/test_moe_sp.py::TestMixtral::test_expert_params_sharded",  # 6.00s
+    "tests/test_moe_sp.py::TestMixtral::test_mixtral_trains",  # 17.35s
+    "tests/test_moe_sp.py::TestMoELayer::test_expert_parallel_matches_single",  # 7.22s
+    "tests/test_moe_sp.py::TestMoELayer::test_forward_shape_and_aux",  # 5.47s
+    "tests/test_moe_sp.py::TestUlysses::test_distributed_attention_matches_local",  # 5.65s
+    "tests/test_multiprocess.py::TestMultiProcess::test_init_and_cross_process_psum",  # 9.24s
+    "tests/test_multiprocess.py::TestMultiProcess::test_zero1_training_across_processes",  # 14.83s
+    "tests/test_multiprocess.py::TestMultiProcess::test_zero3_param_sharding_across_processes",  # 13.66s
+    "tests/test_ops.py::TestFlashAttention::test_backward_matches_reference",  # 4.08s
+    "tests/test_ops.py::TestFusedLionLamb::test_lamb_matches_reference_math",  # 4.29s
+    "tests/test_ops.py::TestFusedLionLamb::test_lamb_transform_trains",  # 7.40s
+    "tests/test_ops.py::TestQuantization::test_quantized_psum_scatter",  # 9.14s
+    "tests/test_ops.py::TestSlidingWindow::test_kernel_bwd_matches_reference",  # 4.87s
+    "tests/test_pipeline.py::test_1f1b_schedule_uses_less_memory_than_gpipe",  # 31.94s
+    "tests/test_pipeline.py::test_gpipe_matches_sequential[2]",  # 4.23s
+    "tests/test_pipeline.py::test_pipeline_1f1b_matches_gpipe_loss",  # 35.15s
+    "tests/test_pipeline.py::test_pipeline_engine_matches_dense",  # 21.23s
+    "tests/test_pipeline.py::test_pipeline_engine_matches_dense_alibi",  # 19.40s
+    "tests/test_pipeline.py::test_pipeline_engine_with_zero_and_data",  # 18.37s
+    "tests/test_pipeline.py::test_pipeline_moe_matches_dense",  # 27.20s
+    "tests/test_pipeline.py::test_pipeline_respects_per_microbatch_mask",  # 17.19s
+    "tests/test_sparse_grads.py::TestEngineSparseGradients::test_llama_trains_with_sparse_gradients",  # 12.71s
+    "tests/test_sparse_grads.py::TestEngineSparseGradients::test_sparse_matches_dense_training",  # 24.38s
+    "tests/test_tensor_logger.py::TestEngineIntegration::test_engine_records_inputs_and_loss",  # 26.48s
+    "tests/test_zeropp.py::TestQgzWire::test_hlo_moves_int8_collectives",  # 7.57s
+    "tests/test_zeropp.py::TestQgzWire::test_replicated_leaf_reduces_over_all_batch_axes",  # 22.59s
+    "tests/test_zeropp.py::TestQgzWire::test_training_converges_close_to_exact",  # 12.62s
+    "tests/test_zeropp.py::test_hpz_training_matches_plain_stage3",  # 9.50s
+    "tests/test_zeropp.py::test_mics_matches_plain_stage3",  # 9.51s
+    "tests/test_zeropp.py::test_mics_topology_mapping",  # 6.04s
+    "tests/test_zeropp.py::test_quantized_all_gather_st_grad",  # 12.18s
+    "tests/test_zeropp.py::test_qwz_trains_and_quantizes",  # 8.11s
+    "tests/test_checkpoint.py::TestHFImportBreadth::test_mixtral_logits_parity",  # 3.10s
+    "tests/test_checkpoint.py::TestMistralParity::test_sliding_window_logits_match_hf",  # 3.44s
+    "tests/test_checkpoint.py::TestTopologyReshape::test_reshape_roundtrip[save_mesh1-load_mesh1]",  # 3.44s
+    "tests/test_data_pipeline.py::test_eigenvalue_quadratic_exact",  # 3.17s
+    "tests/test_engine.py::test_forward_backward_step_compat",  # 3.60s
+    "tests/test_engine.py::test_gradient_accumulation_equivalence",  # 3.16s
+    "tests/test_engine.py::test_zero_stages_converge[1]",  # 3.53s
+    "tests/test_engine.py::test_zero_stages_converge[2]",  # 3.16s
+    "tests/test_engine.py::test_zero_stages_converge[3]",  # 3.18s
+    "tests/test_engine.py::test_zero_state_is_sharded[1]",  # 3.15s
+    "tests/test_engine.py::test_zero_state_is_sharded[3]",  # 3.53s
+    "tests/test_inference_v2.py::TestEngineV2::test_put_and_kv_accounting",  # 3.23s
+    "tests/test_lora_universal.py::test_lora_starts_as_identity_adapter",  # 3.94s
+    "tests/test_offload.py::test_cpu_offload_matches_device_path",  # 3.06s
+    "tests/test_offload.py::test_module_only_load_resyncs_masters",  # 3.08s
+    "tests/test_offload.py::test_nvme_matches_cpu_offload",  # 3.02s
+    "tests/test_ops.py::TestFPQuantizer::test_optimized_linear_fp8_base",  # 3.13s
+    "tests/test_ops.py::TestFusedAdam::test_transform_multi_step",  # 3.94s
+])
