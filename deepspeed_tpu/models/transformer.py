@@ -432,6 +432,16 @@ def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
     # involuntary full remat per transition).
     q_axes = _divisible_head_axes(cfg.num_heads)
     kv_axes = _divisible_head_axes(cfg.kv_heads)
+    # staged like the return leg below: S-over-seq + H-over-tensor first,
+    # then full head sharding — each hop is a plannable all-to-all, and
+    # the TRANSPOSE of this staging keeps the backward cotangents off the
+    # replicate-repartition fallback too
+    if _divisible_head_axes(q.shape[1], ("seq",)):
+        t_q = _divisible_head_axes(cfg.num_heads, ("tensor",))
+        t_kv = _divisible_head_axes(cfg.kv_heads, ("tensor",))
+        q = _constrain(q, BATCH, "seq", t_q or None, None)
+        k = _constrain(k, BATCH, "seq", t_kv or None, None)
+        v = _constrain(v, BATCH, "seq", t_kv or None, None)
     q = _constrain(q, BATCH, None, q_axes or None, None)
     k = _constrain(k, BATCH, None, kv_axes or None, None)
     v = _constrain(v, BATCH, None, kv_axes or None, None)
@@ -443,6 +453,16 @@ def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
     # (cheap: [B,S,H,D]) lets the backward skip re-running the flash
     # kernel while everything else still rematerializes
     out = checkpoint_name(out, "attn_out")
+    # Ulysses return leg, staged: go heads-(seq+tensor) -> (S over seq,
+    # H over tensor) FIRST — a single plannable all-to-all — so the wo
+    # einsum below is Megatron row-parallel (psum over 'tensor') with an
+    # S-sharded output.  Without the stage, GSPMD sees heads-sharded ->
+    # seq-sharded directly and falls back to an involuntary full
+    # rematerialization (replicate + repartition) of the [B,S,H,D]
+    # activation every layer.
+    stage_axes = _divisible_head_axes(out.shape[2], ("tensor",))
+    if _divisible_head_axes(out.shape[1], ("seq",)):
+        out = _constrain(out, BATCH, "seq", stage_axes or None, None)
     out = jnp.einsum("bshd,hde->bse", out, wo)
     if cfg.use_bias:
         out = out + p["bo"].astype(dtype)
