@@ -27,10 +27,18 @@ class AsyncIOHandle:
     waited on; the handle tracks buffers to enforce that.
     """
 
-    def __init__(self, num_threads: int = 4, block_size: int = 1 << 20):
+    def __init__(self, num_threads: int = 4, block_size: int = 1 << 20,
+                 queue_depth: int = 128, use_direct: bool = False):
+        """Reference aio config surface (``aio`` block: thread_count,
+        block_size, queue_depth, single_submit/overlap via the async
+        API itself).  Large requests are striped into ``block_size``
+        parts serviced by all threads concurrently; ``queue_depth``
+        bounds outstanding parts (submit blocks when full);
+        ``use_direct`` requests O_DIRECT when alignment permits."""
         self._lib = AsyncIOBuilder().load()
-        self._handle = self._lib.ds_aio_create(int(num_threads),
-                                               int(block_size))
+        self._handle = self._lib.ds_aio_create2(
+            int(num_threads), int(block_size), int(queue_depth),
+            1 if use_direct else 0)
         if not self._handle:
             raise AsyncIOError("failed to create aio handle")
         # request id -> (buffer keep-alive, expected bytes, is_read)

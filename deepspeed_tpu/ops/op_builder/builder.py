@@ -189,6 +189,9 @@ class AsyncIOBuilder(OpBuilder):
     def _annotate(self, lib):
         lib.ds_aio_create.argtypes = [ctypes.c_int, ctypes.c_int]
         lib.ds_aio_create.restype = ctypes.c_void_p
+        lib.ds_aio_create2.argtypes = [ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_int, ctypes.c_int]
+        lib.ds_aio_create2.restype = ctypes.c_void_p
         lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
         lib.ds_aio_destroy.restype = None
         for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
