@@ -304,9 +304,13 @@ def _train_and_report(jax, n_chips):
     tok_s = tokens_per_step * STEPS / dt
     tok_s_chip = tok_s / n_chips
 
-    # MFU: 6 * n_params * tokens/sec / peak (fwd+bwd), ignoring attention
+    # MFU (PaLM-appendix convention): per-token fwd+bwd model FLOPs =
+    # 6*N (matmuls) + 6*L*S*H (causal attention scores+values, the
+    # 12*L*S*H full-attention term halved) — attention is real work the
+    # MXU does and standard MFU accounting includes it
     n_params = model.cfg.n_params()
-    mfu = 6.0 * n_params * tok_s / (PEAK_FLOPS * n_chips)
+    attn_flops = 6.0 * model.cfg.num_layers * SEQ_LEN * model.cfg.hidden_size
+    mfu = (6.0 * n_params + attn_flops) * tok_s / (PEAK_FLOPS * n_chips)
 
     result = {
         "metric": f"llama-{MODEL_SIZE} bf16 train tokens/sec/chip (seq {SEQ_LEN})",

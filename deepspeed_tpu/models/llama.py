@@ -21,6 +21,9 @@ def llama_config(size: str = "7b", **overrides) -> TransformerConfig:
         # small configs for tests / benches
         "1b": dict(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
                    num_layers=16, num_heads=16, num_kv_heads=16, max_seq_len=2048),
+        # MXU-friendly ~2.1B bench config (head_dim 128, dims % 128 == 0)
+        "2b": dict(vocab_size=32000, hidden_size=2560, intermediate_size=6912,
+                   num_layers=24, num_heads=20, num_kv_heads=20, max_seq_len=2048),
         "tiny": dict(vocab_size=512, hidden_size=128, intermediate_size=352,
                      num_layers=4, num_heads=4, num_kv_heads=2, max_seq_len=256),
         "debug": dict(vocab_size=128, hidden_size=64, intermediate_size=176,
