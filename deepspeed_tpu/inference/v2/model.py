@@ -132,13 +132,23 @@ class RaggedInferenceModel:
             def _shard(leaf):
                 if isinstance(leaf, T.meta.Partitioned):
                     spec = logical_to_mesh_spec(tuple(leaf.names), rules)
-                    # drop axes that don't divide the dim (reference AutoTP
-                    # keeps indivisible modules unsharded)
+                    # drop axes absent from this mesh (a tp-only serving
+                    # mesh has no 'expert' axis) or not dividing the dim
+                    # (reference AutoTP keeps indivisible modules
+                    # unsharded)
                     entries = []
                     for i, entry in enumerate(spec):
-                        size = mesh.shape.get(entry, 1) if entry else 1
-                        ok = entry and leaf.value.shape[i] % size == 0
-                        entries.append(entry if ok else None)
+                        axes = (entry if isinstance(entry, tuple)
+                                else (entry,)) if entry else ()
+                        axes = tuple(a for a in axes
+                                     if a in mesh.axis_names)
+                        size = 1
+                        for a in axes:
+                            size *= mesh.shape[a]
+                        ok = axes and leaf.value.shape[i] % size == 0
+                        entries.append(
+                            (axes if len(axes) > 1 else axes[0])
+                            if ok else None)
                     return jax.device_put(
                         leaf.value,
                         jax.sharding.NamedSharding(mesh, P(*entries)))
