@@ -254,8 +254,14 @@ class InferenceEngineV2:
             for row, i in enumerate(idxs):
                 logits_rows[i] = logits[row]
 
+        window = getattr(self._model.cfg, "sliding_window", None)
         for sd in descs:
             sd.post_forward()
+            if window:
+                # Mistral serving: pages wholly outside the window are
+                # unreachable for every future query — return them to the
+                # pool so live KV is O(window), not O(context)
+                self._state.evict_window(sd, window)
         import jax.numpy as jnp
         return jnp.stack(logits_rows)
 

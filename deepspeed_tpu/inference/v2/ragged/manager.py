@@ -45,7 +45,21 @@ class StateManager:
     def flush_sequence(self, uid: int) -> None:
         sd = self._seqs.pop(uid, None)
         if sd is not None:
-            self.kv_cache.release(sd.pages)
+            # window eviction leaves null-page placeholders — not ours
+            self.kv_cache.release([p for p in sd.pages if p != 0])
+
+    def evict_window(self, sd: SequenceDescriptor, window: int) -> int:
+        """Free every page wholly below ``seen_tokens - window + 1`` (the
+        earliest position any future query can attend).  Returns the
+        number of pages freed."""
+        min_attended = sd.seen_tokens - window + 1
+        if min_attended <= 0:
+            return 0
+        first_live = min_attended // self.kv_config.page_size
+        freed = sd.evict_pages_below(first_live)
+        if freed:
+            self.kv_cache.release(freed)
+        return len(freed)
 
     # -- KV accounting ------------------------------------------------------
     def pages_needed(self, sd: SequenceDescriptor, n_new_tokens: int) -> int:

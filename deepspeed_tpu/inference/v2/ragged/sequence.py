@@ -40,6 +40,20 @@ class SequenceDescriptor:
     def extend_pages(self, pages: np.ndarray) -> None:
         self.pages.extend(int(p) for p in pages)
 
+    def evict_pages_below(self, first_live_page: int) -> List[int]:
+        """Sliding-window eviction: pages wholly below the attention
+        window are dead for every FUTURE query (positions only grow).
+        Their table slots become the null page — masked/skipped by the
+        windowed attention paths — and the page ids are returned for the
+        allocator.  Live KV becomes O(window) while the table stays
+        positional (absolute page index = position // page_size)."""
+        freed = []
+        for i in range(min(first_live_page, len(self.pages))):
+            if self.pages[i] != 0:
+                freed.append(self.pages[i])
+                self.pages[i] = 0
+        return freed
+
     def page_table(self, max_pages: int) -> np.ndarray:
         """Block table row padded with the null page to ``max_pages``."""
         if len(self.pages) > max_pages:

@@ -626,6 +626,52 @@ class TestSlidingWindowServing:
         assert ref_toks == toks, (ref_toks[-6:], toks[-6:])
 
 
+    def test_window_eviction_bounds_live_kv(self):
+        """Decode far past the window: pages wholly below the window are
+        returned to the pool (live KV = O(window)) and the logits still
+        match the training core's windowed forward exactly."""
+        from deepspeed_tpu.models.transformer import forward
+        window, page = 8, 4
+        model_def = LlamaForCausalLM("debug", max_seq_len=256,
+                                     sliding_window=window,
+                                     dtype=jnp.float32)
+        params = meta.unbox(model_def.init_params(jax.random.key(0)))
+        cfg = model_def.cfg
+        kv_cfg = KVCacheConfig(num_layers=cfg.num_layers,
+                               kv_heads=cfg.kv_heads,
+                               head_dim=cfg.dims_per_head, page_size=page,
+                               num_pages=64, dtype=jnp.float32)
+        model = RaggedInferenceModel(cfg, params, kv_config=kv_cfg)
+        eng = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+            state_manager=StateManagerConfig(
+                max_tracked_sequences=2, max_ragged_sequence_count=2,
+                max_ragged_batch_size=256)))
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, cfg.vocab_size, 6)
+        toks = list(prompt)
+        logits = eng.put([1], [np.asarray(prompt)])
+        for _ in range(30):  # run to ~36 tokens: 4.5x the window
+            nxt = int(np.argmax(np.asarray(logits)[0]))
+            toks.append(nxt)
+            logits = eng.put([1], [np.array([nxt])])
+
+        sd = eng.state_manager.get_sequence(1)
+        live = [p for p in sd.pages if p != 0]
+        # live pages bounded by window coverage (+1 partial +1 tail)
+        assert len(live) <= window // page + 2, (len(live), sd.pages)
+        assert len(sd.pages) > len(live), "nothing was evicted"
+        # allocator got the dead pages back
+        used = 64 - eng.free_blocks
+        assert used == len(live), (used, len(live))
+
+        # semantics unchanged vs the dense windowed core
+        ids = jnp.asarray(np.asarray(toks)[None, :], jnp.int32)
+        ref_logits = np.asarray(forward(cfg, params, ids))[0]
+        ref_next = int(np.argmax(ref_logits[-1]))
+        got_next = int(np.argmax(np.asarray(logits)[0]))
+        assert ref_next == got_next
+
+
 class TestPrecompileLattice:
     def test_precompile_covers_serving_and_strict_catches_misses(self):
         eng, _, _ = _tiny_engine(num_pages=64, max_batch=256, max_seqs=4)
