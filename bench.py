@@ -261,11 +261,17 @@ def _sweep():
     results.sort(reverse=True)
     _, model, mbs, remat = results[0]
     sys.stderr.write(f"sweep winner: {model} bs={mbs} {remat}; full run\n")
-    final = run_child({"BENCH_MODEL": model, "BENCH_BS": mbs,
-                       "BENCH_REMAT": remat},
-                      steps=os.environ.get("BENCH_STEPS", "10"),
-                      fastgen=os.environ.get("BENCH_FASTGEN", "1"),
-                      timeout=1800)
+    try:
+        final = run_child({"BENCH_MODEL": model, "BENCH_BS": mbs,
+                           "BENCH_REMAT": remat},
+                          steps=os.environ.get("BENCH_STEPS", "10"),
+                          fastgen=os.environ.get("BENCH_FASTGEN", "1"),
+                          timeout=1800)
+        if "value" not in final:
+            raise ValueError(f"winner rerun returned no metric: {final}")
+    except Exception as e:  # noqa: BLE001 — artifact must be a JSON line
+        _emit_error(
+            f"sweep winner ({model} bs={mbs} {remat}) full rerun failed", e)
     final["swept_configs"] = len(grid)
     print(json.dumps(final), flush=True)
 

@@ -9,9 +9,11 @@
 //
 //  * one large request is STRIPED into block_size parts serviced by all
 //    workers concurrently (the reference splits a tensor across its
-//    thread ring the same way);
-//  * queue_depth bounds outstanding parts — submit blocks when the queue
-//    is full, giving the reference's backpressure semantics;
+//    thread ring the same way); submit() returns immediately — workers
+//    claim parts from per-request cursors, so the caller overlaps I/O
+//    with device compute (the module's purpose);
+//  * queue_depth bounds the parts of ONE request in flight at once (the
+//    reference's per-ring in-flight bound);
 //  * optional O_DIRECT (page-cache bypass) when buffer/offset/length meet
 //    the 4096-byte alignment contract, falling back to buffered I/O
 //    per-request otherwise (no alignment dance forced on callers).
@@ -39,14 +41,6 @@ namespace {
 
 constexpr int64_t kDirectAlign = 4096;
 
-struct Request;
-
-struct Part {
-  Request* parent;
-  int64_t offset_in_req;  // bytes
-  int64_t nbytes;
-};
-
 struct Request {
   bool is_write;
   std::string path;
@@ -55,6 +49,9 @@ struct Request {
   int64_t offset;
   bool use_direct;
   int fd = -1;
+  int nparts = 0;
+  std::atomic<int> next_part{0};      // claim cursor
+  std::atomic<int> running_parts{0};  // queue_depth bound
   std::atomic<int64_t> moved{0};
   std::atomic<int64_t> error{0};  // first -errno
   std::atomic<int> parts_left{0};
@@ -77,6 +74,7 @@ class AioHandle {
     {
       std::unique_lock<std::mutex> lk(mu_);
       stop_ = true;
+      active_.clear();
     }
     cv_.notify_all();
     for (auto& w : workers_) w.join();
@@ -91,10 +89,12 @@ class AioHandle {
     req->buffer = buf;
     req->nbytes = nbytes;
     req->offset = offset;
-    // O_DIRECT only when the whole transfer meets the alignment contract
+    // O_DIRECT only when the whole transfer AND every striped part meet
+    // the alignment contract (parts start at multiples of block_size_)
     req->use_direct =
         use_direct_ && (reinterpret_cast<uintptr_t>(buf) % kDirectAlign == 0) &&
-        (offset % kDirectAlign == 0) && (nbytes % kDirectAlign == 0);
+        (offset % kDirectAlign == 0) && (nbytes % kDirectAlign == 0) &&
+        (block_size_ % kDirectAlign == 0);
 
     int flags = is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
     if (req->use_direct) flags |= O_DIRECT;
@@ -108,23 +108,15 @@ class AioHandle {
     int nparts =
         static_cast<int>(std::max<int64_t>(1, (nbytes + block_size_ - 1) /
                                                   block_size_));
+    req->nparts = nparts;
     req->parts_left.store(nparts);
 
     std::unique_lock<std::mutex> lk(mu_);
     int64_t id = next_id_++;
     inflight_[id] = req;
-    for (int p = 0; p < nparts; ++p) {
-      // queue_depth backpressure: block the submitter, not the workers
-      space_cv_.wait(lk, [&] {
-        return static_cast<int>(queue_.size()) < queue_depth_ || stop_;
-      });
-      if (stop_) break;
-      int64_t off = static_cast<int64_t>(p) * block_size_;
-      queue_.push_back(Part{req.get(), off,
-                            std::min<int64_t>(block_size_, nbytes - off)});
-      cv_.notify_one();
-    }
-    return id;
+    active_.push_back(req);
+    cv_.notify_all();
+    return id;  // immediately: workers claim parts from the cursor
   }
 
   int64_t wait(int64_t id) {
@@ -163,38 +155,66 @@ class AioHandle {
 
   void worker() {
     for (;;) {
-      Part part;
+      std::shared_ptr<Request> req;
+      int part_idx = -1;
       {
         std::unique_lock<std::mutex> lk(mu_);
-        cv_.wait(lk, [&] { return stop_ || !queue_.empty(); });
-        if (stop_ && queue_.empty()) return;
-        part = queue_.front();
-        queue_.pop_front();
-        space_cv_.notify_one();
+        cv_.wait(lk, [&] { return stop_ || claimable(lk, req, part_idx); });
+        if (req == nullptr) {
+          if (stop_) return;
+          continue;
+        }
       }
-      Request& req = *part.parent;
-      int64_t rc = execute(req, part);
+      int64_t off = static_cast<int64_t>(part_idx) * block_size_;
+      int64_t rc = execute(*req, off,
+                           std::min<int64_t>(block_size_, req->nbytes - off));
+      req->running_parts.fetch_sub(1);
       if (rc < 0) {
         int64_t expected = 0;
-        req.error.compare_exchange_strong(expected, rc);
+        req->error.compare_exchange_strong(expected, rc);
       } else {
-        req.moved.fetch_add(rc);
+        req->moved.fetch_add(rc);
       }
-      if (req.parts_left.fetch_sub(1) == 1) {  // last part
+      bool last = req->parts_left.fetch_sub(1) == 1;
+      {
         std::unique_lock<std::mutex> lk(mu_);
-        close_req(req);
-        req.done = true;
-        done_cv_.notify_all();
+        if (last) {
+          close_req(*req);
+          req->done = true;
+          done_cv_.notify_all();
+        }
+        cv_.notify_one();  // a queue_depth slot freed up
       }
     }
   }
 
-  static int64_t execute(Request& req, const Part& part) {
+  // Claim the next part of the first active request with spare
+  // queue_depth slots; prunes fully-claimed requests.  mu_ held.
+  bool claimable(std::unique_lock<std::mutex>&, std::shared_ptr<Request>& req,
+                 int& part_idx) {
+    while (!active_.empty()) {
+      auto& front = active_.front();
+      if (front->next_part.load() >= front->nparts) {
+        active_.pop_front();
+        continue;
+      }
+      if (front->running_parts.load() >= queue_depth_) return false;
+      int p = front->next_part.fetch_add(1);
+      if (p >= front->nparts) continue;  // lost the race to the last part
+      front->running_parts.fetch_add(1);
+      req = front;
+      part_idx = p;
+      return true;
+    }
+    return false;
+  }
+
+  static int64_t execute(Request& req, int64_t part_off, int64_t nbytes) {
     int64_t moved = 0;
-    while (moved < part.nbytes) {
-      char* buf = req.buffer + part.offset_in_req + moved;
-      int64_t want = part.nbytes - moved;
-      int64_t pos = req.offset + part.offset_in_req + moved;
+    while (moved < nbytes) {
+      char* buf = req.buffer + part_off + moved;
+      int64_t want = nbytes - moved;
+      int64_t pos = req.offset + part_off + moved;
       ssize_t rc = req.is_write ? ::pwrite(req.fd, buf, want, pos)
                                 : ::pread(req.fd, buf, want, pos);
       if (rc < 0) return -errno;
@@ -210,10 +230,9 @@ class AioHandle {
   bool stop_;
   int64_t next_id_ = 1;
   std::mutex mu_;
-  std::condition_variable cv_;        // work available
-  std::condition_variable space_cv_;  // queue_depth backpressure
-  std::condition_variable done_cv_;   // completions
-  std::deque<Part> queue_;
+  std::condition_variable cv_;       // parts claimable
+  std::condition_variable done_cv_;  // completions
+  std::deque<std::shared_ptr<Request>> active_;  // requests with parts left
   std::unordered_map<int64_t, std::shared_ptr<Request>> inflight_;
   std::vector<std::thread> workers_;
 };

@@ -53,6 +53,8 @@ class AsyncIOHandle:
         """Async write of the whole buffer; returns a request id."""
         req = self._lib.ds_aio_pwrite(self._handle, path.encode(),
                                       self._buf_ptr(arr), arr.nbytes, offset)
+        if req < 0:  # submit-time failure (open): req is -errno
+            raise AsyncIOError(-req, f"aio submit failed for {path!r}")
         self._inflight[req] = (arr, arr.nbytes, False)
         return req
 
@@ -60,6 +62,8 @@ class AsyncIOHandle:
         """Async read filling the whole buffer; returns a request id."""
         req = self._lib.ds_aio_pread(self._handle, path.encode(),
                                      self._buf_ptr(arr), arr.nbytes, offset)
+        if req < 0:  # submit-time failure (open): req is -errno
+            raise AsyncIOError(-req, f"aio submit failed for {path!r}")
         self._inflight[req] = (arr, arr.nbytes, True)
         return req
 

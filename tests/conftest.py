@@ -53,9 +53,6 @@ def pytest_collection_modifyitems(config, items):
     tests/unit/ci_promote_marker.py pattern: per-tier markers maintained
     centrally, test bodies untouched)."""
     from heavy_marker import HEAVY_TESTS
-    import pathlib
-    root = pathlib.Path(str(config.rootdir))
     for item in items:
-        rel = item.nodeid
-        if rel in HEAVY_TESTS:
+        if item.nodeid in HEAVY_TESTS:
             item.add_marker(pytest.mark.heavy)

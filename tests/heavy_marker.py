@@ -118,4 +118,14 @@ HEAVY_TESTS = frozenset([
     "tests/test_offload.py::test_nvme_matches_cpu_offload",  # 3.02s
     "tests/test_ops.py::TestFPQuantizer::test_optimized_linear_fp8_base",  # 3.13s
     "tests/test_ops.py::TestFusedAdam::test_transform_multi_step",  # 3.94s
+    "tests/test_inference_v1.py::TestPerArchTPInference::test_tp2_matches_unsharded[bloom]",  # HF build + tp=2 engine
+    "tests/test_inference_v1.py::TestPerArchTPInference::test_tp2_matches_unsharded[falcon]",  # HF build + tp=2 engine
+    "tests/test_inference_v1.py::TestPerArchTPInference::test_tp2_matches_unsharded[opt]",  # HF build + tp=2 engine
+    "tests/test_inference_v1.py::TestPerArchTPInference::test_tp2_matches_unsharded[gpt_neox]",  # HF build + tp=2 engine
+    "tests/test_inference_v2.py::TestSlidingWindowServing::test_window_eviction_bounds_live_kv",  # engine + 31 puts
+    "tests/test_checkpoint.py::TestMistralParity::test_sliding_window_logits_match_hf",  # HF parity
+    "tests/test_checkpoint.py::TestMistralParity::test_factory_picks_arch_implementation",  # two HF engine builds
+    "tests/test_zeropp.py::TestQgzWire::test_training_converges_close_to_exact",  # two engines x 6 steps
+    "tests/test_zeropp.py::TestQgzWire::test_replicated_leaf_reduces_over_all_batch_axes",  # shard_map compiles
+    "tests/test_engine.py::test_destroyed_engine_raises_clearly",  # engine construction
 ])

@@ -15,7 +15,6 @@ import pytest
 from distributed_harness import run_distributed
 
 
-@pytest.mark.slow
 class TestMultiProcess:
     def test_init_and_cross_process_psum(self):
         outs = run_distributed("""
