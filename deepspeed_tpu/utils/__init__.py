@@ -1,3 +1,5 @@
 from .logging import log_dist, logger  # noqa: F401
 from .memory import (device_memory_report,  # noqa: F401
                      host_peak_rss_bytes, see_memory_usage)
+from .nvtx import (instrument_w_nvtx, nvtx_range,  # noqa: F401
+                   range_pop, range_push, start_trace, stop_trace)

@@ -1,0 +1,70 @@
+"""Trace-region instrumentation (reference ``utils/nvtx.py``
+``instrument_w_nvtx`` + ``accelerator.range_push/pop``,
+abstract_accelerator.py:190-194).
+
+On TPU the NVTX analogue is the XProf trace-me region:
+``jax.profiler.TraceAnnotation`` labels host-side spans (and the device
+ops dispatched inside them) in the profile collected by
+``start_trace``/``stop_trace`` — readable with TensorBoard's profile
+plugin or xprof.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Optional
+
+import jax
+
+
+def instrument_w_nvtx(fn):
+    """Decorator: run ``fn`` inside a named trace region (reference
+    ``instrument_w_nvtx`` wraps with nvtx.range)."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(fn.__qualname__):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
+@contextlib.contextmanager
+def nvtx_range(name: str):
+    """Context-manager form (reference accelerator.range_push/pop pair)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def range_push(name: str):
+    """Imperative push (reference range_push) — prefer ``nvtx_range``."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    _range_stack.append(ann)
+
+
+def range_pop():
+    if _range_stack:
+        _range_stack.pop().__exit__(None, None, None)
+
+
+_range_stack: list = []
+
+
+def start_trace(log_dir: str) -> None:
+    """Begin an XProf trace capture (reference: external nsys/nvprof)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a trace for the enclosed region; view with TensorBoard's
+    profile plugin pointed at ``log_dir``."""
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()

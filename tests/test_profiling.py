@@ -60,3 +60,29 @@ def test_engine_profile_step_prints(capsys):
     out = capsys.readouterr().out
     assert "Flops Profiler" in out
     assert "fwd+bwd+step flops" in out
+
+
+class TestTraceAnnotations:
+    def test_instrument_and_ranges_run(self, tmp_path):
+        """XProf trace-region surface (reference utils/nvtx.py): the
+        decorator and push/pop must compose with jit and produce a
+        loadable trace directory."""
+        from deepspeed_tpu.utils import (instrument_w_nvtx, nvtx_range,
+                                         range_pop, range_push)
+        from deepspeed_tpu.utils.nvtx import trace
+        import jax.numpy as jnp
+
+        @instrument_w_nvtx
+        def step(x):
+            return jax.jit(lambda v: v * 2 + 1)(x)
+
+        with trace(str(tmp_path)):
+            with nvtx_range("outer"):
+                range_push("inner")
+                out = step(jnp.ones((8, 8)))
+                range_pop()
+        assert float(out.sum()) == 8 * 8 * 3
+        import os
+        assert any("plugins" in d or "trace" in str(f).lower()
+                   for d, _, fs in os.walk(tmp_path) for f in fs + [d]), \
+            "no trace artifacts written"
