@@ -102,6 +102,16 @@ class DeepSpeedAccelerator(abc.ABC):
     def supported_dtypes(self) -> List[str]:
         return ["float32", "bfloat16", "float16", "int8", "float8_e4m3fn", "float8_e5m2"]
 
+    # -- trace regions (reference range_push/pop, :190-194) ---------------
+    def range_push(self, name: str) -> None:
+        """XProf trace-me region begin (the NVTX analogue)."""
+        from ..utils.nvtx import range_push
+        range_push(name)
+
+    def range_pop(self) -> None:
+        from ..utils.nvtx import range_pop
+        range_pop()
+
     # -- graphs: jit IS the graph machinery on TPU ------------------------
     def create_graph(self):
         raise NotImplementedError("use jax.jit; XLA compilation replaces graph capture")
