@@ -133,9 +133,12 @@ class InferenceEngineV2:
                 for P in p_vals:
                     if P * page < Q:  # bucket can't hold its own tokens
                         continue
-                    key = (S, Q, P)
-                    self._model.precompile_step(key, kv)
-                    keys.append(key)
+                    # Q>1 buckets exist in both variants: fresh prefill
+                    # (flash path) and continued prefill (paged path)
+                    for fresh in ((False, True) if Q > 1 else (False,)):
+                        key = (S, Q, P, fresh)
+                        self._model.precompile_step(key, kv)
+                        keys.append(key)
         if strict:
             self._model.strict_shapes = True
         return keys

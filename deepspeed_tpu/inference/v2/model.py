@@ -108,6 +108,11 @@ class RaggedInferenceModel:
         from .modules import instantiate
         self._attention = instantiate("ragged_attention", cfg,
                                       name=attention_impl)
+        try:
+            self._fresh_attention = instantiate("fresh_prefill_attention",
+                                                cfg)
+        except (KeyError, ValueError):
+            self._fresh_attention = None
         self._norm = instantiate("norm", cfg)
         self._embed = instantiate("embedding", cfg)
         self._unembed = instantiate("unembed", cfg)
@@ -236,18 +241,24 @@ class RaggedInferenceModel:
                           batch.start_pos, batch.page_table)
         return logits, kv
 
-    def _get_step(self, key: Tuple[int, int, int]) -> Callable:
+    def _get_step(self, key) -> Callable:
         fn = self._step_cache.get(key)
         if fn is None:
             if getattr(self, "strict_shapes", False):
                 raise RuntimeError(
-                    f"batch bucket {key} (S, Q, P) was not precompiled — "
-                    "live serving would eat this XLA compile as a TTFT "
-                    "spike.  Widen InferenceEngineV2.precompile(...) or "
-                    "disable strict_shapes.")
-            fn = jax.jit(self._step_impl, donate_argnums=(1,))
+                    f"batch bucket {key} (S, Q, P, fresh) was not "
+                    "precompiled — live serving would eat this XLA "
+                    "compile as a TTFT spike.  Widen "
+                    "InferenceEngineV2.precompile(...) or disable "
+                    "strict_shapes.")
+            fn = jax.jit(functools.partial(
+                self._step_impl, fresh=self._fresh_of(key)),
+                donate_argnums=(1,))
             self._step_cache[key] = fn
         return fn
+
+    def _fresh_of(self, key) -> bool:
+        return bool(key[3]) if len(key) > 3 else False
 
     def precompile_step(self, key: Tuple[int, int, int],
                         kv_aval) -> None:
@@ -255,10 +266,12 @@ class RaggedInferenceModel:
         graphs are captured at engine build; under XLA the analogue is
         lower().compile() before serving so no bucket compiles on the
         request path)."""
-        S, Q, P = key
+        S, Q, P = key[:3]
         if key in self._step_cache:
             return
-        fn = jax.jit(self._step_impl, donate_argnums=(1,))
+        fn = jax.jit(functools.partial(
+            self._step_impl, fresh=self._fresh_of(key)),
+            donate_argnums=(1,))
         i32 = jnp.int32
         # the COMPILED executable goes into the cache: later calls with
         # the bucket's exact shapes dispatch straight to it (jit's own
@@ -271,7 +284,7 @@ class RaggedInferenceModel:
             jax.ShapeDtypeStruct((S, P), i32)).compile()
 
     def _step_impl(self, params, kv, token_ids, q_lens, start_pos,
-                   page_table):
+                   page_table, fresh: bool = False):
         cfg = self.cfg
         S, Q = token_ids.shape
         x = self._embed(params["embed"]["tokens"].astype(cfg.dtype),
@@ -287,7 +300,7 @@ class RaggedInferenceModel:
 
         body = functools.partial(self._layer_body, pos=pos, sin=sin, cos=cos,
                                  q_lens=q_lens, start_pos=start_pos,
-                                 page_table=page_table)
+                                 page_table=page_table, fresh=fresh)
         if cfg.scan_layers:
             x, kv = jax.lax.scan(
                 lambda carry, xs: (body(carry, xs[0], xs[1])),
@@ -309,7 +322,7 @@ class RaggedInferenceModel:
         return logits.astype(jnp.float32), kv
 
     def _layer_body(self, x, lp, kv_layer, *, pos, sin, cos, q_lens,
-                    start_pos, page_table):
+                    start_pos, page_table, fresh: bool = False):
         cfg = self.cfg
         dtype = cfg.dtype
         h = self._norm(lp["norm1"], x)
@@ -321,14 +334,34 @@ class RaggedInferenceModel:
             q = q + ap["bq"].astype(dtype)
             k = k + ap["bk"].astype(dtype)
             v = v + ap["bv"].astype(dtype)
+        k_rot = None
         if cfg.pos_emb == "rope":
             q = T.apply_rope(q, sin, cos)
-            kv_layer = rope_write_kv(kv_layer, k, v, sin, cos, page_table,
-                                     start_pos, q_lens)
+            if fresh and self._fresh_attention is not None:
+                # fresh path reads the rotated K directly: rotate once,
+                # write unfused (the fused rope_write_kv would force a
+                # second rotate for the flash read)
+                k_rot = T.apply_rope(k, sin, cos)
+                kv_layer = write_kv(kv_layer, k_rot, v, page_table,
+                                    start_pos, q_lens)
+            else:
+                kv_layer = rope_write_kv(kv_layer, k, v, sin, cos,
+                                         page_table, start_pos, q_lens)
         else:
+            k_rot = k
             kv_layer = write_kv(kv_layer, k, v, page_table, start_pos,
                                 q_lens)
-        attn = self._attention(q, kv_layer, page_table, start_pos, q_lens)
+        if fresh and self._fresh_attention is not None:
+            # pure prefill: every slot's context IS its own new tokens —
+            # flash over [S(batch), H, Q, D], no paged gather at all
+            # (reference blocked_flash prefill atoms); padding-tail rows
+            # are garbage but only feed rows that logits_gather ignores
+            # and KV slots the null page swallows
+            attn = self._fresh_attention(
+                q, k_rot if k_rot is not None else k, v)
+        else:
+            attn = self._attention(q, kv_layer, page_table, start_pos,
+                                   q_lens)
         out = jnp.einsum("sqhd,hde->sqe", attn, T._wval(ap["wo"], dtype))
         if cfg.use_bias:
             out = out + ap["bo"].astype(dtype)

@@ -129,6 +129,42 @@ def _alibi_for(cfg):
     return alibi_slopes(cfg.num_heads)
 
 
+def _no_alibi(cfg) -> bool:
+    # the flash kernel has no additive-bias input; ALiBi prefill stays on
+    # the paged dense path
+    return getattr(cfg, "pos_emb", None) != "alibi"
+
+
+@register("fresh_prefill_attention", "flash", priority=10,
+          supports=_no_alibi)
+def _fresh_flash(cfg):
+    """Pure-prefill bucket (every slot at position 0): context IS the new
+    tokens, so attention runs the flash kernel over [S(batch), H, Q, D]
+    with causal (+ sliding window) blocking — no paged gather, no
+    [Q, C] score materialization (reference blocked_flash prefill atoms,
+    inference/v2/kernels/ragged_ops/).  Off-TPU the kernel falls back to
+    the dense reference with identical semantics."""
+    import jax.numpy as jnp
+
+    from ...ops.flash_attention import flash_attention
+    window = getattr(cfg, "sliding_window", None)
+    block_q = getattr(cfg, "flash_block_q", 512)
+    block_k = getattr(cfg, "flash_block_k", 512)
+
+    def attn(q, k_rot, v):
+        qf = q.transpose(0, 2, 1, 3)        # [S, H, Q, D]
+        kf = k_rot.transpose(0, 2, 1, 3)    # [S, K, Q, D]
+        vf = v.transpose(0, 2, 1, 3)
+        groups = qf.shape[1] // kf.shape[1]
+        if groups > 1:
+            kf = jnp.repeat(kf, groups, axis=1)
+            vf = jnp.repeat(vf, groups, axis=1)
+        out = flash_attention(qf, kf, vf, causal=True, window=window,
+                              block_q=block_q, block_k=block_k)
+        return out.transpose(0, 2, 1, 3)
+    return attn
+
+
 # norm implementations share the (params, x) -> y calling convention
 @register("norm", "pallas_fused", priority=10, supports=_on_tpu)
 def _pallas_norm(cfg):

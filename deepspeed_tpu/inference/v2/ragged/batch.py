@@ -41,6 +41,10 @@ class RaggedBatch:
     start_pos: np.ndarray    # [S] int32
     page_table: np.ndarray   # [S, P] int32
     uids: List[int]          # live uids, in slot order (len <= S)
+    #: every slot starts at position 0 (pure fresh prefill) — a STATIC
+    #: property of the bucket, so the compiled step may use the flash
+    #: kernel over the new tokens instead of the paged gather
+    fresh: bool = False
 
     @property
     def num_slots(self) -> int:
@@ -55,9 +59,9 @@ class RaggedBatch:
         return len(self.uids)
 
     @property
-    def shape_key(self) -> Tuple[int, int, int]:
+    def shape_key(self) -> Tuple[int, int, int, bool]:
         return (self.token_ids.shape[0], self.token_ids.shape[1],
-                self.page_table.shape[1])
+                self.page_table.shape[1], self.fresh)
 
 
 def build_batch(seqs: Sequence[SequenceDescriptor],
@@ -88,4 +92,6 @@ def build_batch(seqs: Sequence[SequenceDescriptor],
         start_pos[i] = sd.seen_tokens
         page_table[i] = sd.page_table(P)
         uids.append(sd.uid)
-    return RaggedBatch(token_ids, q_lens, start_pos, page_table, uids)
+    fresh = Q > 1 and all(s.seen_tokens == 0 for s in seqs)
+    return RaggedBatch(token_ids, q_lens, start_pos, page_table, uids,
+                       fresh=fresh)

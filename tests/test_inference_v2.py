@@ -691,3 +691,34 @@ class TestPrecompileLattice:
             eng.put([3], [big])
         eng.model.strict_shapes = False
         eng.put([3], [big])  # and compiles fine when strictness is off
+
+
+class TestFreshPrefillFlash:
+    def test_fresh_bucket_uses_flash_and_matches_paged(self):
+        """Pure-prefill buckets route through the flash implementation
+        (fresh=True key) and must produce the same logits as the paged
+        gather path on identical params/prompt."""
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, 100, 24)
+
+        def build():
+            eng, model_def, params = _tiny_engine()
+            return eng
+
+        eng = build()
+        logits = eng.put([1], [np.asarray(prompt)])
+        keys = list(eng.model._step_cache)
+        assert any(len(k) > 3 and k[3] for k in keys), \
+            f"no fresh bucket compiled: {keys}"
+
+        eng2 = build()
+        eng2.model._fresh_attention = None  # force paged path
+        logits2 = eng2.put([1], [np.asarray(prompt)])
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2),
+                                   rtol=2e-5, atol=2e-5)
+
+        # continued prefill (history present) must NOT take the fresh path
+        eng.put([1], [rng.integers(0, 100, 8)])
+        cont = [k for k in eng.model._step_cache
+                if len(k) > 3 and k[1] == 8]
+        assert cont and not any(k[3] for k in cont)
