@@ -94,6 +94,19 @@ class OffloadConfig(DeepSpeedConfigModel):
     aio_threads: int = 4  # NVMe swapper I/O thread pool size
 
 
+class AioConfig(DeepSpeedConfigModel):
+    """Top-level ``aio`` block (reference op_builder/async_io defaults):
+    tunes the NVMe swapper's native I/O pool (ops/aio).  single_submit /
+    overlap_events are accepted for config compatibility — the thread
+    pool always submits asynchronously and overlaps by construction."""
+    block_size: int = 1 << 20
+    queue_depth: int = 128
+    thread_count: int = 4
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_direct_io: bool = False  # O_DIRECT when alignment permits
+
+
 class ZeroConfig(DeepSpeedConfigModel):
     """``zero_optimization`` section (reference runtime/zero/config.py).
 
@@ -315,6 +328,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
     activation_checkpointing: ActivationCheckpointingConfig = Field(
         default_factory=ActivationCheckpointingConfig)
+    aio: AioConfig = Field(default_factory=AioConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
     sequence_parallel: SequenceParallelConfig = Field(default_factory=SequenceParallelConfig)

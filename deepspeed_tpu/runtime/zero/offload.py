@@ -41,12 +41,15 @@ class NVMeStateSwapper:
     """
 
     def __init__(self, swap_dir: str, aio_threads: int = 4,
-                 block_size: int = 1 << 20):
+                 block_size: int = 1 << 20, queue_depth: int = 128,
+                 use_direct: bool = False):
         from ...ops.aio import AsyncIOHandle
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
         self.handle = AsyncIOHandle(num_threads=aio_threads,
-                                    block_size=block_size)
+                                    block_size=block_size,
+                                    queue_depth=queue_depth,
+                                    use_direct=use_direct)
         self._pending_reads: Dict[str, Tuple[int, np.ndarray]] = {}
         self._on_disk: set = set()
 
@@ -102,10 +105,14 @@ class HostOffloadOptimizer:
         self._build_host_optimizer(opt_cfg)
         self.swapper: Optional[NVMeStateSwapper] = None
         if self.device == "nvme":
+            aio = config.aio
             self.swapper = NVMeStateSwapper(
                 os.path.join(off.nvme_path or "/tmp/ds_tpu_nvme",
                              f"rank{jax.process_index()}"),
-                aio_threads=int(getattr(off, "aio_threads", 4)))
+                aio_threads=max(int(getattr(off, "aio_threads", 4)),
+                                aio.thread_count),
+                block_size=aio.block_size, queue_depth=aio.queue_depth,
+                use_direct=aio.use_direct_io)
         self.masters: List[np.ndarray] = []
         n_off = sum(int(np.prod(l.shape)) for l in self._leaves(self.offload_idx))
         n_all = sum(int(np.prod(l.shape)) for l in self._flat_abstract)
