@@ -305,6 +305,18 @@ def flash_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v) -> jax.Ar
         from jax import shard_map
         batch_axes = tuple(a for a in BATCH if a in mesh.axis_names)
         head_axes = tuple(a for a in ("seq", "tensor") if a in mesh.axis_names)
+        head_shards = 1
+        for a in head_axes:
+            head_shards *= mesh.shape[a]
+        if kf.shape[1] % max(head_shards, 1) != 0:
+            # GQA with fewer kv heads than head shards (e.g. 2 kv heads
+            # over seq*tensor = 4): repeat kv up to the q heads BEFORE
+            # the manual region so the head split divides — same
+            # semantics, and flash still beats the einsum fallback for
+            # any nontrivial sequence length
+            groups = qf.shape[1] // kf.shape[1]
+            kf = jnp.repeat(kf, groups, axis=1)
+            vf = jnp.repeat(vf, groups, axis=1)
         spec = P(batch_axes or None, head_axes or None, None, None)
         out = shard_map(per_shard, mesh=mesh,
                         in_specs=(spec, spec, spec), out_specs=spec,
@@ -335,8 +347,10 @@ def _flash_ok(cfg: TransformerConfig, n_heads: int, n_kv: int,
                 batch_shards *= mesh.shape[a]
         if batch % batch_shards != 0:
             return False
-    return (n_heads % head_shards == 0 and n_kv % head_shards == 0
-            and head_shards <= n_kv)
+    # kv heads that don't divide the shards are repeated up to n_heads
+    # before the manual region (flash_dot_product_attention), so q-head
+    # divisibility is the only hard constraint
+    return n_heads % head_shards == 0 and head_shards <= n_heads
 
 
 def _divisible_head_axes(n: int, axes=("seq", "tensor")) -> tuple:
