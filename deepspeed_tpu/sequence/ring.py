@@ -38,8 +38,13 @@ def _block_attn(q, k, v, scale, mask):
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = "seq",
                    causal: bool = True,
-                   sm_scale: Optional[float] = None) -> jax.Array:
-    """q, k, v: [B, H, S_local, D] inside shard_map over ``axis_name``."""
+                   sm_scale: Optional[float] = None,
+                   window: Optional[int] = None) -> jax.Array:
+    """q, k, v: [B, H, S_local, D] inside shard_map over ``axis_name``.
+    ``window``: Mistral sliding-window ((t-window, t]) — long-context CP
+    training of windowed models; requires ``causal``."""
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal ring attention")
     p = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
@@ -52,6 +57,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         src = (r - i) % p  # whose block we currently hold
         k_pos = src * s_local + lax.broadcasted_iota(jnp.int32, (1, s_local), 1)
         mask = (q_pos >= k_pos) if causal else jnp.ones((s_local, s_local), bool)
+        if window is not None:
+            mask &= (q_pos - k_pos) < window
         mask = mask[None, None]
         m_blk, l_blk, acc_blk = _block_attn(q, kv_k, kv_v, scale, mask)
 
@@ -79,13 +86,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return (acc / l).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, causal: bool = True):
+def ring_attention_sharded(q, k, v, mesh, causal: bool = True,
+                           window: Optional[int] = None):
     """Convenience wrapper: q,k,v [B,H,S,D] globally, seq-sharded on 'seq'."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
     spec = P(None, None, "seq", None)
     fn = shard_map(
-        functools.partial(ring_attention, axis_name="seq", causal=causal),
+        functools.partial(ring_attention, axis_name="seq", causal=causal,
+                          window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

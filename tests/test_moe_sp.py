@@ -209,3 +209,19 @@ class TestRingAttention:
         ref = mha_reference(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
+
+
+class TestRingSlidingWindow:
+    def test_windowed_ring_matches_reference(self):
+        topo = MeshTopology(TopologyConfig(seq=4, data=2))
+        b, h, s, d = 1, 2, 64, 32
+        q, k, v = [jnp.asarray(np.random.default_rng(i).normal(
+            size=(b, h, s, d)).astype(np.float32)) for i in range(3)]
+        out = ring_attention_sharded(q, k, v, topo.mesh, causal=True,
+                                     window=12)
+        ref = mha_reference(q, k, v, causal=True, window=12)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        # and differs from the unwindowed result
+        full = np.asarray(mha_reference(q, k, v, causal=True))
+        assert not np.allclose(np.asarray(out)[0, 0, -1], full[0, 0, -1])
