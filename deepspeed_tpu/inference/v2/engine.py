@@ -134,8 +134,14 @@ class InferenceEngineV2:
                     if P * page < Q:  # bucket can't hold its own tokens
                         continue
                     # Q>1 buckets exist in both variants: fresh prefill
-                    # (flash path) and continued prefill (paged path)
-                    for fresh in ((False, True) if Q > 1 else (False,)):
+                    # (flash path) and continued prefill (paged path) —
+                    # but only when the model HAS a fresh implementation
+                    # (ALiBi models ignore the flag; compiling the True
+                    # variant would duplicate every prefill executable)
+                    has_fresh = getattr(self._model, "_fresh_attention",
+                                        None) is not None
+                    for fresh in ((False, True) if Q > 1 and has_fresh
+                                  else (False,)):
                         key = (S, Q, P, fresh)
                         self._model.precompile_step(key, kv)
                         keys.append(key)

@@ -106,11 +106,16 @@ class HostOffloadOptimizer:
         self.swapper: Optional[NVMeStateSwapper] = None
         if self.device == "nvme":
             aio = config.aio
+            # aio.thread_count is authoritative when the user set it;
+            # else the offload block's aio_threads (max() of the two
+            # defaults could never LOWER the pool)
+            threads = (aio.thread_count
+                       if "thread_count" in aio.model_fields_set
+                       else int(getattr(off, "aio_threads", 4)))
             self.swapper = NVMeStateSwapper(
                 os.path.join(off.nvme_path or "/tmp/ds_tpu_nvme",
                              f"rank{jax.process_index()}"),
-                aio_threads=max(int(getattr(off, "aio_threads", 4)),
-                                aio.thread_count),
+                aio_threads=threads,
                 block_size=aio.block_size, queue_depth=aio.queue_depth,
                 use_direct=aio.use_direct_io)
         self.masters: List[np.ndarray] = []

@@ -59,14 +59,23 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         mask = (q_pos >= k_pos) if causal else jnp.ones((s_local, s_local), bool)
         if window is not None:
             mask &= (q_pos - k_pos) < window
-        mask = mask[None, None]
-        m_blk, l_blk, acc_blk = _block_attn(q, kv_k, kv_v, scale, mask)
 
-        m_new = jnp.maximum(m_run, m_blk)
-        alpha = jnp.exp(m_run - m_new)
-        beta = jnp.exp(m_blk - m_new)
-        l_new = l_run * alpha + l_blk * beta
-        acc_new = acc_run * alpha + acc_blk * beta
+        def attend(carry):
+            m_run, l_run, acc_run = carry
+            m_blk, l_blk, acc_blk = _block_attn(q, kv_k, kv_v, scale,
+                                                mask[None, None])
+            m_new = jnp.maximum(m_run, m_blk)
+            alpha = jnp.exp(m_run - m_new)
+            beta = jnp.exp(m_blk - m_new)
+            return (m_new, l_run * alpha + l_blk * beta,
+                    acc_run * alpha + acc_blk * beta)
+
+        # skip blocks with no visible element: fully above the diagonal
+        # (causal) or fully below the window band — this is what makes
+        # windowed ring attention O(S*window) instead of O(S^2/P)
+        any_visible = jnp.any(mask)
+        m_new, l_new, acc_new = lax.cond(
+            any_visible, attend, lambda c: c, (m_run, l_run, acc_run))
 
         # rotate K/V for the next step; the last iteration's rotation is
         # skipped (its result would be discarded)

@@ -39,15 +39,26 @@ def range_push(name: str):
     """Imperative push (reference range_push) — prefer ``nvtx_range``."""
     ann = jax.profiler.TraceAnnotation(name)
     ann.__enter__()
-    _range_stack.append(ann)
+    _ranges().append(ann)
 
 
 def range_pop():
-    if _range_stack:
-        _range_stack.pop().__exit__(None, None, None)
+    stack = _ranges()
+    if stack:
+        stack.pop().__exit__(None, None, None)
 
 
-_range_stack: list = []
+import threading as _threading
+
+_tls = _threading.local()
+
+
+def _ranges() -> list:
+    # per-thread, like NVTX ranges (a swapper thread's region must not
+    # be poppable from the main thread)
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
 
 
 def start_trace(log_dir: str) -> None:
