@@ -276,3 +276,13 @@ class InferenceEngineV2:
 
     def flush(self, uid: int) -> None:
         self._state.flush_sequence(uid)
+
+    def offload_sequence(self, uid: int) -> None:
+        """Preempt a sequence: its KV moves to host and the pages return
+        to the pool (reference BlockedKVCache offload hook,
+        inference/v2/ragged/kv_cache.py:166).  put() for this uid is
+        invalid until restore_sequence."""
+        self._state.offload_sequence(uid)
+
+    def restore_sequence(self, uid: int) -> None:
+        self._state.restore_sequence(uid)

@@ -73,3 +73,26 @@ class BlockedKVCache:
     def release(self, pages) -> None:
         if len(pages):
             self.allocator.free(pages)
+
+    # -- sequence offload/restore (reference kv_cache.py:166-184) --------
+    def offload_pages(self, pages) -> "np.ndarray":
+        """Copy the given pages to HOST memory and free them on device —
+        the preemption half of the reference's offload/restore hooks
+        (evict a long sequence's KV under pressure, bring it back
+        later).  Returns the host blob [L, n, page, 2, K, D]."""
+        import numpy as np
+        idx = jnp.asarray(list(pages), jnp.int32)
+        blob = np.asarray(self.data[:, idx])
+        self.release(list(pages))
+        return blob
+
+    def restore_pages(self, blob) -> "np.ndarray":
+        """Allocate fresh pages and write a host blob back; returns the
+        new page ids (the sequence's table must be updated to them)."""
+        import numpy as np
+        n = blob.shape[1]
+        pages = self.reserve(n)
+        idx = jnp.asarray(pages, jnp.int32)
+        self.data = self.data.at[:, idx].set(
+            jnp.asarray(blob, self.cfg.dtype))
+        return np.asarray(pages)

@@ -48,6 +48,34 @@ class StateManager:
             # window eviction leaves null-page placeholders — not ours
             self.kv_cache.release([p for p in sd.pages if p != 0])
 
+    def offload_sequence(self, uid: int) -> None:
+        """Preempt: move a sequence's live KV pages to host memory and
+        free them (reference kv_cache offload hook).  The sequence stays
+        tracked; it cannot be scheduled until restore_sequence."""
+        sd = self._seqs[uid]
+        if sd.host_blob is not None:
+            return
+        sd.live_slots = [i for i, p in enumerate(sd.pages) if p != 0]
+        live = [sd.pages[i] for i in sd.live_slots]
+        if not live:
+            sd.host_blob = None
+            return
+        sd.host_blob = self.kv_cache.offload_pages(live)
+        for i in sd.live_slots:
+            sd.pages[i] = 0
+
+    def restore_sequence(self, uid: int) -> None:
+        """Bring a preempted sequence's KV back onto device (reference
+        restore hook).  Raises if the pool lacks free pages."""
+        sd = self._seqs[uid]
+        if sd.host_blob is None:
+            return
+        pages = self.kv_cache.restore_pages(sd.host_blob)
+        for slot, p in zip(sd.live_slots, pages):
+            sd.pages[slot] = int(p)
+        sd.host_blob = None
+        sd.live_slots = []
+
     def evict_window(self, sd: SequenceDescriptor, window: int) -> int:
         """Free every page wholly below ``seen_tokens - window + 1`` (the
         earliest position any future query can attend).  Returns the

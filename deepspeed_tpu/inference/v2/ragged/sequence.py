@@ -25,6 +25,11 @@ class SequenceDescriptor:
     pages: List[int] = dataclasses.field(default_factory=list)
     #: tokens in flight in the current forward (pre_forward..post_forward)
     in_flight_tokens: int = 0
+    #: host KV blob while preempted (offload_sequence), else None
+    host_blob: object = None
+    #: table slots the blob's pages belonged to (window-evicted slots
+    #: stay null through an offload/restore cycle)
+    live_slots: List[int] = dataclasses.field(default_factory=list)
 
     @property
     def allocated_capacity(self) -> int:
