@@ -78,6 +78,7 @@ class FastGenScheduler:
         self._budget = (token_budget or
                         engine._config.state_manager.max_ragged_batch_size)
         self._pending: List[Request] = []     # waiting for first prefill
+        self._preempted: Dict[int, Request] = {}  # KV offloaded to host
         self._running: Dict[int, Request] = {}
         self._rng = rng if rng is not None else jax.random.key(0)
         self.last_step_scheduled = 0
@@ -89,9 +90,11 @@ class FastGenScheduler:
             uid=uid, prompt=np.asarray(prompt, dtype=np.int32),
             params=params or SamplingParams()))
 
+    last_step_preempted: Optional[int] = None
+
     @property
     def has_work(self) -> bool:
-        return bool(self._pending or self._running)
+        return bool(self._pending or self._running or self._preempted)
 
     # -- one engine step -----------------------------------------------------
     def step(self, on_token: Optional[Callable[[int, int], None]] = None
@@ -101,6 +104,17 @@ class FastGenScheduler:
         uids: List[int] = []
         tokens: List[np.ndarray] = []
         reqs: List[Request] = []
+
+        # resume preempted sequences first when the pool has room again
+        # (restore cost = their live page count, plus decode headroom)
+        for uid in list(self._preempted):
+            sd = self._engine.state_manager.get_sequence(uid)
+            need = (sd.host_blob.shape[1] if sd is not None
+                    and sd.host_blob is not None else 0)
+            if self._engine.free_blocks >= need + 1:
+                self._engine.restore_sequence(uid)
+                self._running[uid] = self._preempted.pop(uid)
+
         adm = _Admission(self._engine, self._budget)
 
         # 1. all running decodes (one token each)
@@ -141,6 +155,18 @@ class FastGenScheduler:
 
         self.last_step_scheduled = len(uids)
         if not uids:
+            # nothing schedulable but work remains: preempt the running
+            # sequence holding the most KV so the others can finish —
+            # its pages go to host via the offload hook and it resumes
+            # automatically once the pool frees up
+            if self._running:
+                victim = max(
+                    self._running,
+                    key=lambda u: (self._engine.state_manager
+                                   .get_sequence(u).allocated_capacity))
+                self._engine.offload_sequence(victim)
+                self._preempted[victim] = self._running.pop(victim)
+                self.last_step_preempted = victim
             return {}
 
         logits = self._engine.put(uids, tokens, do_checks=False)
@@ -181,10 +207,14 @@ class FastGenScheduler:
     def run_to_completion(self) -> Dict[int, List[int]]:
         all_reqs = {r.uid: r for r in self._pending}
         all_reqs.update(self._running)
+        all_reqs.update(self._preempted)
         stalls = 0
         while self.has_work:
+            before = self.last_step_preempted
             self.step()
             if self.last_step_scheduled == 0:
+                if self.last_step_preempted != before:
+                    continue  # preemption IS progress: pages were freed
                 stalls += 1
                 if stalls >= 2:
                     raise RuntimeError(

@@ -756,3 +756,24 @@ class TestKVOffloadRestore:
         eng.restore_sequence(1)
         toks_b, _ = decode(eng, logits, 4)
         assert toks_a + toks_b == ref_toks
+
+    def test_scheduler_preempts_and_resumes_under_kv_pressure(self):
+        """A KV pool too small for all sequences at once: the SplitFuse
+        scheduler preempts the largest sequence (KV to host), finishes
+        the others, restores it, and every request still completes with
+        full-length outputs."""
+        from deepspeed_tpu.inference.v2 import (FastGenScheduler,
+                                                SamplingParams)
+        # pool: 12 pages x 16 = 192 token capacity
+        eng, _, _ = _tiny_engine(num_pages=12, max_batch=256, max_seqs=4)
+        rng = np.random.default_rng(0)
+        sched = FastGenScheduler(eng)
+        sp = SamplingParams(max_new_tokens=24, temperature=0.0)
+        lens = [100, 60, 40]  # 200 + decode > pool: must preempt
+        for uid, n in enumerate(lens):
+            sched.submit(uid, rng.integers(0, 100, n).tolist(), sp)
+        outs = sched.run_to_completion()
+        assert sorted(outs) == [0, 1, 2]
+        assert all(len(v) == 24 for v in outs.values()), \
+            {k: len(v) for k, v in outs.items()}
+        assert not sched._preempted
