@@ -48,6 +48,14 @@ def pages_for_memory(cfg: KVCacheConfig, budget_bytes: int) -> int:
     return max(1, budget_bytes // cfg.bytes_per_page)
 
 
+import functools
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(data, idx, blob):
+    return data.at[:, idx].set(blob)
+
+
 class BlockedKVCache:
     """Device cache array + host page allocator."""
 
@@ -88,11 +96,14 @@ class BlockedKVCache:
 
     def restore_pages(self, blob) -> "np.ndarray":
         """Allocate fresh pages and write a host blob back; returns the
-        new page ids (the sequence's table must be updated to them)."""
+        new page ids (the sequence's table must be updated to them).
+        The scatter DONATES the cache buffer — an out-of-place update
+        would transiently need ~2x the KV pool, an OOM exactly in the
+        memory-pressure situation preemption exists to relieve."""
         import numpy as np
         n = blob.shape[1]
         pages = self.reserve(n)
         idx = jnp.asarray(pages, jnp.int32)
-        self.data = self.data.at[:, idx].set(
-            jnp.asarray(blob, self.cfg.dtype))
+        self.data = _scatter_pages(self.data, idx,
+                                   jnp.asarray(blob, self.cfg.dtype))
         return np.asarray(pages)

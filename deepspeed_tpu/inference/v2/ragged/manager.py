@@ -52,9 +52,9 @@ class StateManager:
         """Preempt: move a sequence's live KV pages to host memory and
         free them (reference kv_cache offload hook).  The sequence stays
         tracked; it cannot be scheduled until restore_sequence."""
-        sd = self._seqs[uid]
-        if sd.host_blob is not None:
-            return
+        sd = self._seqs.get(uid)
+        if sd is None or sd.host_blob is not None:
+            return  # unknown/flushed uids tolerated like flush_sequence
         sd.live_slots = [i for i, p in enumerate(sd.pages) if p != 0]
         live = [sd.pages[i] for i in sd.live_slots]
         if not live:
@@ -67,8 +67,8 @@ class StateManager:
     def restore_sequence(self, uid: int) -> None:
         """Bring a preempted sequence's KV back onto device (reference
         restore hook).  Raises if the pool lacks free pages."""
-        sd = self._seqs[uid]
-        if sd.host_blob is None:
+        sd = self._seqs.get(uid)
+        if sd is None or sd.host_blob is None:
             return
         pages = self.kv_cache.restore_pages(sd.host_blob)
         for slot, p in zip(sd.live_slots, pages):

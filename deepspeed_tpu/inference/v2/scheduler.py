@@ -79,6 +79,7 @@ class FastGenScheduler:
                         engine._config.state_manager.max_ragged_batch_size)
         self._pending: List[Request] = []     # waiting for first prefill
         self._preempted: Dict[int, Request] = {}  # KV offloaded to host
+        self._preempted_this_step = False
         self._running: Dict[int, Request] = {}
         self._rng = rng if rng is not None else jax.random.key(0)
         self.last_step_scheduled = 0
@@ -89,8 +90,6 @@ class FastGenScheduler:
         self._pending.append(Request(
             uid=uid, prompt=np.asarray(prompt, dtype=np.int32),
             params=params or SamplingParams()))
-
-    last_step_preempted: Optional[int] = None
 
     @property
     def has_work(self) -> bool:
@@ -105,12 +104,15 @@ class FastGenScheduler:
         tokens: List[np.ndarray] = []
         reqs: List[Request] = []
 
+        self._preempted_this_step = False
         # resume preempted sequences first when the pool has room again
         # (restore cost = their live page count, plus decode headroom)
         for uid in list(self._preempted):
             sd = self._engine.state_manager.get_sequence(uid)
-            need = (sd.host_blob.shape[1] if sd is not None
-                    and sd.host_blob is not None else 0)
+            if sd is None:  # flushed/cancelled while preempted
+                self._preempted.pop(uid)
+                continue
+            need = sd.host_blob.shape[1] if sd.host_blob is not None else 0
             if self._engine.free_blocks >= need + 1:
                 self._engine.restore_sequence(uid)
                 self._running[uid] = self._preempted.pop(uid)
@@ -160,13 +162,16 @@ class FastGenScheduler:
             # its pages go to host via the offload hook and it resumes
             # automatically once the pool frees up
             if self._running:
-                victim = max(
-                    self._running,
-                    key=lambda u: (self._engine.state_manager
-                                   .get_sequence(u).allocated_capacity))
-                self._engine.offload_sequence(victim)
-                self._preempted[victim] = self._running.pop(victim)
-                self.last_step_preempted = victim
+                # rank by LIVE pages (window eviction leaves null slots
+                # in sd.pages — they free nothing)
+                def live_pages(u):
+                    sd = self._engine.state_manager.get_sequence(u)
+                    return sum(1 for p in sd.pages if p != 0) if sd else 0
+                victim = max(self._running, key=live_pages)
+                if live_pages(victim) > 0:
+                    self._engine.offload_sequence(victim)
+                    self._preempted[victim] = self._running.pop(victim)
+                    self._preempted_this_step = True
             return {}
 
         logits = self._engine.put(uids, tokens, do_checks=False)
@@ -210,10 +215,9 @@ class FastGenScheduler:
         all_reqs.update(self._preempted)
         stalls = 0
         while self.has_work:
-            before = self.last_step_preempted
             self.step()
             if self.last_step_scheduled == 0:
-                if self.last_step_preempted != before:
+                if self._preempted_this_step:
                     continue  # preemption IS progress: pages were freed
                 stalls += 1
                 if stalls >= 2:
