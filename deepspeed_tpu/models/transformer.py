@@ -89,6 +89,13 @@ class TransformerConfig:
     # auto: Pallas flash kernel whenever the mask is pure-causal (TPU;
     # jnp reference off-TPU) | flash: force | einsum: dense path
     attention_impl: str = "auto"
+    # sequence-parallel mechanism when the mesh has a 'seq' axis:
+    # "ulysses" reshards tokens->heads around attention (two
+    # all-to-alls); "ring" keeps tokens seq-sharded and circulates K/V
+    # blocks over ppermute (context parallelism — O(S/P) activation
+    # memory with no head-divisibility requirement).  Wired from engine
+    # config sequence_parallel.mode.
+    sp_mode: str = "ulysses"
     flash_block_q: int = 512
     flash_block_k: int = 512
     # sparse embedding gradients (reference engine.py:2535 sparse
@@ -326,6 +333,54 @@ def flash_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v) -> jax.Ar
     return out.transpose(0, 2, 1, 3)
 
 
+def ring_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v
+                               ) -> jax.Array:
+    """Causal attention with tokens kept SEQ-SHARDED: K/V blocks travel
+    the 'seq' ring via ppermute (sequence/ring.py) while queries stay
+    put — context parallelism as the reference-parity alternative to the
+    Ulysses all-to-all sandwich.  q: [B,S,H,D], k/v: [B,S,K,D]."""
+    from ..sequence.ring import ring_attention
+
+    qf = q.transpose(0, 2, 1, 3)      # [B,H,S,D]
+    kf = kv_k.transpose(0, 2, 1, 3)
+    vf = kv_v.transpose(0, 2, 1, 3)
+    groups = qf.shape[1] // kf.shape[1]
+    if groups > 1:  # ring attends full heads; lift GQA before the ring
+        kf = jnp.repeat(kf, groups, axis=1)
+        vf = jnp.repeat(vf, groups, axis=1)
+
+    mesh = _ambient_mesh()
+    from jax import shard_map
+    batch_axes = tuple(a for a in BATCH if a in mesh.axis_names)
+    head_axes = _divisible_head_axes(qf.shape[1], ("tensor",))
+    spec = P(batch_axes or None, head_axes or None, "seq", None)
+    out = shard_map(
+        functools.partial(ring_attention, axis_name="seq", causal=True,
+                          window=cfg.sliding_window),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)(qf, kf, vf)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _ring_ok(cfg: TransformerConfig, seq_len: int,
+             batch: Optional[int] = None) -> bool:
+    """Trace-time check for the ring layout: a real 'seq' axis whose size
+    divides the sequence, plus exact batch divisibility (shard_map)."""
+    mesh = _ambient_mesh()
+    if mesh is None or mesh.shape.get("seq", 1) <= 1:
+        return False
+    if seq_len % mesh.shape["seq"] != 0:
+        return False
+    if batch is not None:
+        batch_shards = 1
+        for a in BATCH:
+            if a in mesh.axis_names:
+                batch_shards *= mesh.shape[a]
+        if batch % batch_shards != 0:
+            return False
+    return True
+
+
 def _flash_ok(cfg: TransformerConfig, n_heads: int, n_kv: int,
               batch: Optional[int] = None) -> bool:
     """Trace-time check that the flash layout divides the active mesh.
@@ -424,7 +479,8 @@ def dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v,
 
 
 def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
-                     use_flash: bool = False, attn_bias=None):
+                     use_flash: bool = False, attn_bias=None,
+                     use_ring: bool = False):
     dtype = cfg.dtype
     wq, wk, wv, wo = (p["wq"].astype(dtype), p["wk"].astype(dtype),
                       p["wv"].astype(dtype), p["wo"].astype(dtype))
@@ -438,6 +494,17 @@ def _attention_block(cfg: TransformerConfig, p, x, sin, cos, mask,
     if cfg.pos_emb == "rope":
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
+    if use_ring:
+        # ring CP: tokens STAY seq-sharded; no head resharding at all
+        q = _constrain(q, BATCH, "seq", None, None)
+        k = _constrain(k, BATCH, "seq", None, None)
+        v = _constrain(v, BATCH, "seq", None, None)
+        out = ring_dot_product_attention(cfg, q, k, v)
+        out = checkpoint_name(out, "attn_out")
+        out = jnp.einsum("bshd,hde->bse", out, wo)
+        if cfg.use_bias:
+            out = out + p["bo"].astype(dtype)
+        return _constrain(out, BATCH, "seq", None)
     # Ulysses resharding: tokens seq-sharded -> heads ('seq'+'tensor')-sharded.
     # XLA materializes this as the two all-to-alls of reference
     # sequence/layer.py:65, but fused into the surrounding program.
@@ -499,13 +566,14 @@ def _mlp_block(cfg: TransformerConfig, p, x):
 
 
 def _layer_body(cfg: TransformerConfig, layer_params, x, sin, cos, mask,
-                mlp_fn=None, use_flash: bool = False, attn_bias=None):
+                mlp_fn=None, use_flash: bool = False, attn_bias=None,
+                use_ring: bool = False):
     """Returns (x, aux) — aux is 0 for dense MLPs, the load-balancing loss
     for MoE mlp_fns (accumulated through the layer scan)."""
     h = _norm_apply(cfg, layer_params["norm1"], x)
     attn_out = _attention_block(cfg, layer_params["attn"], h, sin, cos,
                                 mask, use_flash=use_flash,
-                                attn_bias=attn_bias)
+                                attn_bias=attn_bias, use_ring=use_ring)
     if cfg.parallel_residual:
         # GPT-NeoX: mlp sees ln2(x), both branches add to the SAME input
         h2 = _norm_apply(cfg, layer_params["norm2"], x)
@@ -568,14 +636,17 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
 
     # Flash is valid only for the standard dense-causal case: default
     # positions (no packing) and no padding mask.  Decided at trace time.
-    use_flash = (cfg.attention_impl != "einsum"
-                 and cfg.causal
-                 and attention_mask is None
-                 and positions is None
-                 and cfg.pos_emb != "alibi"  # kernel has no bias input
-                 and s > 1
+    pure_causal = (cfg.causal and attention_mask is None
+                   and positions is None and cfg.pos_emb != "alibi"
+                   and s > 1)
+    # ring CP replaces the Ulysses reshard entirely when configured
+    use_ring = (cfg.sp_mode == "ring" and pure_causal
+                and _ring_ok(cfg, s, batch=b))
+    use_flash = (not use_ring
+                 and cfg.attention_impl != "einsum"
+                 and pure_causal
                  and _flash_ok(cfg, cfg.num_heads, cfg.kv_heads, batch=b))
-    if cfg.attention_impl == "flash" and not use_flash:
+    if cfg.attention_impl == "flash" and not (use_flash or use_ring):
         raise ValueError(
             "attention_impl='flash' requires causal attention with default "
             "positions, no attention_mask, and a mesh the head layout divides")
@@ -609,7 +680,7 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
 
     # mask: [B, S(q), S(k)]  (not needed on the flash path — the kernel
     # applies causality blockwise)
-    if use_flash:
+    if use_flash or use_ring:
         mask = None
     elif cfg.causal:
         mask = positions[:, :, None] >= positions[:, None, :]
@@ -633,7 +704,8 @@ def forward(cfg: TransformerConfig, params, input_ids: jax.Array,
             jnp.float32)                                      # [B,H,T]
 
     body = functools.partial(_layer_body, cfg, mlp_fn=mlp_fn,
-                             use_flash=use_flash, attn_bias=attn_bias)
+                             use_flash=use_flash, attn_bias=attn_bias,
+                             use_ring=use_ring)
 
     # partition_activations: the layer-boundary residual (what the scan
     # carry chain / checkpoint saves) is sharded along seq over the

@@ -284,6 +284,9 @@ class DeepSpeedEngine:
                 "dots_saveable": "offload_dots",
             }.get(base, "offload_attn_out")
             overrides["remat"] = True
+        sp = self.config.sequence_parallel
+        if sp.enabled and sp.mode != "ulysses":
+            overrides["sp_mode"] = sp.mode
         if self.config.sparse_gradients:
             # reference top-level key: embedding grads take the sparse
             # (indexed-slices) backward, runtime/sparse_tensor.py

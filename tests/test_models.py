@@ -199,3 +199,36 @@ def test_learned_positions_ignore_padding():
             attention_mask=jnp.asarray(case["mask"].astype(np.int32))))
         np.testing.assert_allclose(out[0, case["sel"]], ref[0], atol=2e-2,
                                    rtol=2e-2)
+
+
+def test_ring_sp_mode_matches_ulysses():
+    """sequence_parallel.mode='ring' trains with context parallelism
+    (K/V on the ppermute ring) and must match the Ulysses mode loss for
+    loss on the same mesh/model/data."""
+    from deepspeed_tpu.parallel.topology import MeshTopology, TopologyConfig
+
+    def run(mode):
+        model = LlamaForCausalLM("debug", num_heads=4, num_kv_heads=2,
+                                 max_seq_len=32)
+        topo = MeshTopology(TopologyConfig(data=2, seq=4))
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "sequence_parallel": {"enabled": True, "sp_size": 4,
+                                  "mode": mode},
+            "steps_per_print": 1000,
+        }
+        engine, _, _, _ = dst.initialize(model=model, config=cfg,
+                                         topology=topo)
+        if mode == "ring":
+            assert model.cfg.sp_mode == "ring"
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, model.cfg.vocab_size,
+            size=(engine.train_batch_size(), 32)).astype(np.int32)}
+        return [float(engine.train_batch(batch)) for _ in range(3)]
+
+    ring = run("ring")
+    uly = run("ulysses")
+    np.testing.assert_allclose(ring, uly, rtol=2e-3)
