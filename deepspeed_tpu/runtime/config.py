@@ -407,3 +407,54 @@ def load_config(config: Union[str, dict, DeepSpeedTPUConfig, None]) -> DeepSpeed
         with open(config) as f:
             config = json.load(f)
     return DeepSpeedTPUConfig(**config)
+
+
+# ---------------------------------------------------------------------------
+# accepted-for-compatibility keys with no XLA-side behavior.  The engine
+# calls warn_noop_keys at init: any of these the user EXPLICITLY set gets
+# one loud log line naming the reason, so surface never silently exceeds
+# substance (the round-4 verdict's partition_activations lesson).
+# ---------------------------------------------------------------------------
+
+_NOOP_KEYS = {
+    ("zero_optimization", "overlap_comm"):
+        "XLA's latency-hiding scheduler overlaps collectives automatically",
+    ("zero_optimization", "contiguous_gradients"):
+        "gradients live in XLA-managed buffers; no fragmentation to manage",
+    ("zero_optimization", "reduce_bucket_size"):
+        "the compiler fuses/schedules reductions; no manual bucketing",
+    ("zero_optimization", "allgather_bucket_size"):
+        "the compiler fuses/schedules gathers; no manual bucketing",
+    ("zero_optimization", "round_robin_gradients"):
+        "grad layout is a sharding assignment, not a rank rotation",
+    ("zero_optimization", "memory_efficient_linear"):
+        "XLA rematerialization covers it; see tpu.remat_policy",
+    ("zero_optimization", "mics_hierarchical_params_gather"):
+        "the hpz mesh axis provides the hierarchical gather",
+    ("activation_checkpointing", "contiguous_memory_optimization"):
+        "XLA owns activation buffers",
+    ("activation_checkpointing", "number_checkpoints"):
+        "the scanned layer body is the checkpoint unit",
+    ("activation_checkpointing", "synchronize_checkpoint_boundary"):
+        "XLA dataflow ordering replaces manual syncs",
+    ("activation_checkpointing", "profile"):
+        "use utils.nvtx.trace / the flops profiler",
+    ("aio", "single_submit"):
+        "the native pool always submits asynchronously",
+    ("aio", "overlap_events"):
+        "completion overlap is inherent to the thread pool",
+    ("checkpoint", "use_node_local_storage"):
+        "Orbax paths are caller-controlled; point save_dir at local disk",
+    ("checkpoint", "parallel_write"):
+        "Orbax writes shards in parallel already",
+}
+
+
+def warn_noop_keys(config: "DeepSpeedTPUConfig") -> None:
+    from ..utils.logging import logger
+    for (section, key), reason in _NOOP_KEYS.items():
+        sub = getattr(config, section, None)
+        if sub is not None and key in getattr(sub, "model_fields_set", ()):
+            logger.warning(
+                "config %s.%s is accepted for compatibility but has no "
+                "effect on TPU: %s", section, key, reason)

@@ -146,6 +146,8 @@ class DeepSpeedEngine:
                  lr_scheduler: Any = None,
                  dont_change_device: bool = False):
         self.config = load_config(config)
+        from .config import warn_noop_keys
+        warn_noop_keys(self.config)
         self.module = model
         self._apply_model_overrides()
         dist.init_distributed()
@@ -168,6 +170,11 @@ class DeepSpeedEngine:
                                              or self.config.precision_dtype == "float32")
                              else self.compute_dtype)
 
+        if self.config.tpu.matmul_precision != "default":
+            # reference has no analogue; on TPU this selects the MXU pass
+            # count (bfloat16 -> 1 pass, tensorfloat32/float32 -> 3/6)
+            jax.config.update("jax_default_matmul_precision",
+                              self.config.tpu.matmul_precision)
         self._rng = rng if rng is not None else jax.random.key(0)
         self._loss_fn = loss_fn if loss_fn is not None else getattr(model, "loss", None)
         if self._loss_fn is None:
@@ -474,6 +481,10 @@ class DeepSpeedEngine:
 
         param_specs = partitioner.tree_param_specs(self._abstract_params)
         gspecs = partitioner.tree_grad_specs(self._abstract_params)
+        # reference bf16_optimizer fp32 grad accumulation; disabling
+        # halves the accumulator memory (pure-bf16 training)
+        acc_dtype = (jnp.float32 if cfg.bf16.accumulate_grads_in_fp32
+                     else compute_dtype)
 
         # ZeRO++ qwZ (zero_quantized_weights): compute weights snap to the
         # int8 blockwise grid before use, reproducing the numerics of the
@@ -580,7 +591,7 @@ class DeepSpeedEngine:
                         return (l * state.loss_scale).astype(jnp.float32)
                     loss, grads = jax.value_and_grad(scaled_loss)(params_c)
                     grads = jax.tree.map(
-                        lambda g: g.astype(jnp.float32), grads)
+                        lambda g: g.astype(acc_dtype), grads)
                 # fp32 accumulation (reference bf16_optimizer immediate
                 # hp-grad accumulation), born reduce-scattered for stage>=2
                 grads = constrain(grads, gspecs)
@@ -588,7 +599,7 @@ class DeepSpeedEngine:
                 return carry, loss / state.loss_scale
 
             zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params_c)
+                lambda p: jnp.zeros(p.shape, acc_dtype), params_c)
             rngs = jax.random.split(rng, gas)
             if fused_mb:
                 # loss is already a mean over every micro-batch token
@@ -1014,6 +1025,27 @@ class DeepSpeedEngine:
                         load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
         self._check_not_destroyed()
+        if self.config.checkpoint.load_universal:
+            # reference --universal-checkpoint load path: restore the
+            # topology-free atoms regardless of the saving mesh.  Accepts
+            # a universal dir directly, or the checkpoint dir whose
+            # <tag>_universal sibling ds_to_universal wrote.
+            from ..checkpoint.universal import load_universal_into_engine
+            cand = None
+            if os.path.exists(os.path.join(load_dir, "atoms.npz")):
+                cand = load_dir
+            else:
+                t = tag or self.checkpoint_engine.read_latest(load_dir)
+                if t is not None:
+                    c = os.path.join(load_dir, f"{t}_universal")
+                    if os.path.exists(os.path.join(c, "atoms.npz")):
+                        cand = c
+            if cand is None:
+                raise FileNotFoundError(
+                    f"checkpoint.load_universal: no universal atoms under "
+                    f"{load_dir!r} — run ds_to_universal first")
+            load_universal_into_engine(self, cand)
+            return load_dir, {}
         tag = tag or self.checkpoint_engine.read_latest(load_dir)
         if tag is None:
             return None, {}

@@ -341,3 +341,39 @@ class TestMemoryIntrospection:
                       data_fn=lambda bs: {}, base_config={},
                       num_params=10 ** 6)
         assert t.hbm_bytes is None or t.hbm_bytes > 0
+
+
+class TestConfigHonesty:
+    def test_noop_keys_warn_when_explicitly_set(self, monkeypatch):
+        from deepspeed_tpu.runtime import config as cmod
+        from deepspeed_tpu.runtime.config import load_config, warn_noop_keys
+        from deepspeed_tpu.utils import logging as lmod
+        records = []
+        monkeypatch.setattr(lmod.logger, "warning",
+                            lambda msg, *a: records.append(msg % a))
+        warn_noop_keys(load_config(
+            {"zero_optimization": {"overlap_comm": True},
+             "aio": {"single_submit": True}}))
+        text = "\n".join(records)
+        assert "overlap_comm" in text and "single_submit" in text
+        # un-set keys stay silent
+        records.clear()
+        warn_noop_keys(load_config({}))
+        assert not records
+
+    def test_matmul_precision_and_bf16_accumulation_knobs(self):
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.models.base import SimpleModel
+        eng, *_ = dst.initialize(model=SimpleModel(16), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True, "accumulate_grads_in_fp32": False},
+            "tpu": {"matmul_precision": "highest"},
+            "steps_per_print": 1000})
+        assert jax.config.jax_default_matmul_precision == "highest"
+        rng = np.random.default_rng(0)
+        bs = eng.train_batch_size()
+        batch = {"x": rng.normal(size=(bs, 16)).astype(np.float32),
+                 "y": rng.normal(size=(bs, 16)).astype(np.float32)}
+        assert np.isfinite(eng.train_batch(batch))
+        jax.config.update("jax_default_matmul_precision", None)

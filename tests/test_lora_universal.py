@@ -212,3 +212,22 @@ def test_universal_pipe_tp_to_fsdp_bitwise(tmp_path):
 def _flat(tree):
     from deepspeed_tpu.checkpoint.zero_to_fp32 import flatten_state_dict
     return flatten_state_dict(tree, sep="/")
+
+
+def test_load_universal_config_flag(tmp_path):
+    """checkpoint.load_universal routes engine.load_checkpoint through
+    the universal atoms (reference --universal-checkpoint)."""
+    eng, *_ = dst.initialize(model=SimpleModel(16), config=CFG_A)
+    eng.train_batch(_batch())
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    ds_to_universal(str(tmp_path / "ck"), tag="t")
+
+    cfg_b = dict(CFG_B)
+    cfg_b["checkpoint"] = {"async_save": False, "load_universal": True}
+    eng2, *_ = dst.initialize(model=SimpleModel(16), config=cfg_b)
+    eng2.load_checkpoint(str(tmp_path / "ck"))
+    a = np.asarray(jax.tree.leaves(eng.state.params)[0])
+    b = np.asarray(jax.tree.leaves(eng2.state.params)[0])
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(FileNotFoundError, match="universal"):
+        eng2.load_checkpoint(str(tmp_path / "nowhere"))
