@@ -128,4 +128,10 @@ HEAVY_TESTS = frozenset([
     "tests/test_zeropp.py::TestQgzWire::test_training_converges_close_to_exact",  # two engines x 6 steps
     "tests/test_zeropp.py::TestQgzWire::test_replicated_leaf_reduces_over_all_batch_axes",  # shard_map compiles
     "tests/test_engine.py::test_destroyed_engine_raises_clearly",  # engine construction
+    "tests/test_models.py::test_ring_sp_mode_matches_ulysses",  # 2 engines x 2 meshes
+    "tests/test_lora_universal.py::test_load_universal_config_flag",  # 2 engines + ckpt io
+    "tests/test_inference_v2.py::TestKVOffloadRestore::test_preempt_and_resume_matches_uninterrupted",  # 2 engines
+    "tests/test_inference_v2.py::TestKVOffloadRestore::test_scheduler_preempts_and_resumes_under_kv_pressure",  # engine + long run
+    "tests/test_inference_v2.py::TestFreshPrefillFlash::test_fresh_bucket_uses_flash_and_matches_paged",  # 2 engines
+    "tests/test_foundation.py::TestConfigHonesty::test_matmul_precision_and_bf16_accumulation_knobs",  # engine build
 ])
