@@ -107,6 +107,29 @@ def _init_backend():
             f"{last_err}\n")
         time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
         delay = min(delay * 2, 60.0)
+    if os.environ.get("BENCH_CPU_FALLBACK", "1") != "0":
+        # The chip is unavailable (e.g. held by another tenant).  Rather
+        # than record only an error, prove the harness end-to-end on the
+        # CPU backend with an EXPLICIT label — vs_baseline stays 0 (a
+        # CPU number is not an MFU claim) and the TPU error is carried
+        # in the artifact.
+        sys.stderr.write(
+            "bench: TPU unavailable — running LABELED cpu fallback\n")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("BENCH_FALLBACK_MODEL", "debug")
+        os.environ["BENCH_FASTGEN"] = os.environ.get("BENCH_FASTGEN", "1")
+        global MODEL_SIZE, SEQ_LEN, MICRO_BS, STEPS
+        MODEL_SIZE = os.environ["BENCH_FALLBACK_MODEL"]
+        SEQ_LEN = min(SEQ_LEN, 512)
+        MICRO_BS = min(MICRO_BS, 2)
+        STEPS = min(STEPS, 5)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            _train_and_report(jax, 1, cpu_fallback=str(last_err)[:300])
+            sys.exit(0)
+        except Exception as e:  # noqa: BLE001
+            _emit_error("cpu fallback failed too", e)
     _emit_error("JAX backend init failed (TPU busy/unavailable?)", last_err)
 
 
@@ -280,7 +303,7 @@ def _sweep():
     print(json.dumps(final), flush=True)
 
 
-def _train_and_report(jax, n_chips):
+def _train_and_report(jax, n_chips, cpu_fallback=None):
     import deepspeed_tpu as dst
     from deepspeed_tpu.models.llama import LlamaForCausalLM
 
@@ -330,6 +353,13 @@ def _train_and_report(jax, n_chips):
         "remat_policy": REMAT_POLICY,
         "micro_bs": MICRO_BS,
     }
+    if cpu_fallback is not None:
+        # loud, unmistakable labeling: this is NOT a TPU measurement
+        result["metric"] = ("CPU-FALLBACK (TPU unavailable) " +
+                            result["metric"])
+        result["vs_baseline"] = 0
+        result["cpu_fallback"] = True
+        result["tpu_error"] = cpu_fallback
     del engine  # release training buffers before the inference leg
     if os.environ.get("BENCH_FASTGEN", "1") != "0":
         result.update(bench_fastgen(jax))
