@@ -115,21 +115,12 @@ def _init_backend():
         # in the artifact.
         sys.stderr.write(
             "bench: TPU unavailable — running LABELED cpu fallback\n")
+        # re-exec for a CLEAN interpreter: if this process ever touched
+        # the backend (the re-exec-exhausted flap path), the failed init
+        # is cached for process life and no config.update can undo it
         os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.setdefault("BENCH_FALLBACK_MODEL", "debug")
-        os.environ["BENCH_FASTGEN"] = os.environ.get("BENCH_FASTGEN", "1")
-        global MODEL_SIZE, SEQ_LEN, MICRO_BS, STEPS
-        MODEL_SIZE = os.environ["BENCH_FALLBACK_MODEL"]
-        SEQ_LEN = min(SEQ_LEN, 512)
-        MICRO_BS = min(MICRO_BS, 2)
-        STEPS = min(STEPS, 5)
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            _train_and_report(jax, 1, cpu_fallback=str(last_err)[:300])
-            sys.exit(0)
-        except Exception as e:  # noqa: BLE001
-            _emit_error("cpu fallback failed too", e)
+        os.environ["BENCH_FORCE_CPU"] = str(last_err)[:300]
+        os.execv(sys.executable, [sys.executable] + sys.argv)
     _emit_error("JAX backend init failed (TPU busy/unavailable?)", last_err)
 
 
@@ -231,6 +222,19 @@ def bench_fastgen(jax):
 def main():
     if os.environ.get("BENCH_SWEEP"):
         return _sweep()  # parent never touches the chip: children own it
+    forced = os.environ.get("BENCH_FORCE_CPU")
+    if forced:
+        global MODEL_SIZE, SEQ_LEN, MICRO_BS, STEPS
+        MODEL_SIZE = os.environ.get("BENCH_FALLBACK_MODEL", "debug")
+        SEQ_LEN = min(SEQ_LEN, 512)
+        MICRO_BS = min(MICRO_BS, 2)
+        STEPS = min(STEPS, 5)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            return _train_and_report(jax, 1, cpu_fallback=forced)
+        except Exception as e:  # noqa: BLE001
+            _emit_error("cpu fallback failed too", e)
     jax, n_chips = _init_backend()
     try:
         _train_and_report(jax, n_chips)

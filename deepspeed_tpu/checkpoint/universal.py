@@ -107,7 +107,9 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
 
 
 def load_universal_into_engine(engine, universal_dir: str,
-                               strict: bool = True) -> None:
+                               strict: bool = True,
+                               load_optimizer_states: bool = True,
+                               load_lr_scheduler_states: bool = True) -> None:
     """Restore a universal checkpoint into an engine with a possibly
     DIFFERENT topology (new dp/tp/pp/fsdp mesh) — the reference's
     ``--universal-checkpoint`` load path (universal_checkpoint.py:22)."""
@@ -159,19 +161,25 @@ def load_universal_into_engine(engine, universal_dir: str,
     with engine.topology.mesh:
         new_params = rebuild(state.params, _params_shardings(engine),
                              "params/")
-        new_opt = rebuild(state.opt_state, sh.opt_state, "opt_state/")
-    engine.state = state.replace(
-        params=new_params, opt_state=new_opt,
-        step=jnp.asarray(meta["step"], jnp.int32),
-        loss_scale=jnp.asarray(meta["loss_scale"], jnp.float32),
-        good_steps=jnp.asarray(meta["good_steps"], jnp.int32),
-        skipped_steps=jnp.asarray(meta["skipped_steps"], jnp.int32),
-        hysteresis=jnp.asarray(meta["hysteresis"], jnp.int32))
+        new_opt = (rebuild(state.opt_state, sh.opt_state, "opt_state/")
+                   if load_optimizer_states else state.opt_state)
+    if load_optimizer_states:
+        engine.state = state.replace(
+            params=new_params, opt_state=new_opt,
+            step=jnp.asarray(meta["step"], jnp.int32),
+            loss_scale=jnp.asarray(meta["loss_scale"], jnp.float32),
+            good_steps=jnp.asarray(meta["good_steps"], jnp.int32),
+            skipped_steps=jnp.asarray(meta["skipped_steps"], jnp.int32),
+            hysteresis=jnp.asarray(meta["hysteresis"], jnp.int32))
+    else:
+        # weights-only (reference load_module_only): fresh optimizer
+        # trajectory, counters untouched
+        engine.state = state.replace(params=new_params)
     cs = meta.get("client_state", {})
     engine.global_steps = cs.get("global_steps", meta["step"])
     engine.global_samples = cs.get("global_samples", 0)
     engine.micro_steps = cs.get("micro_steps", 0)
-    if "lr_scheduler" in cs:
+    if load_lr_scheduler_states and "lr_scheduler" in cs:
         engine.lr_scheduler.load_state_dict(cs["lr_scheduler"])
     logger.info("universal checkpoint loaded from %s into mesh %s",
                 universal_dir,

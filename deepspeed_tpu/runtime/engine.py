@@ -170,11 +170,14 @@ class DeepSpeedEngine:
                                              or self.config.precision_dtype == "float32")
                              else self.compute_dtype)
 
-        if self.config.tpu.matmul_precision != "default":
-            # reference has no analogue; on TPU this selects the MXU pass
-            # count (bfloat16 -> 1 pass, tensorfloat32/float32 -> 3/6)
-            jax.config.update("jax_default_matmul_precision",
-                              self.config.tpu.matmul_precision)
+        # reference has no analogue; on TPU this selects the MXU pass
+        # count (bfloat16 -> 1 pass, tensorfloat32/float32 -> 3/6).
+        # Always applied — 'default' RESETS to None so one engine's
+        # setting cannot leak into the next engine in the process.
+        jax.config.update(
+            "jax_default_matmul_precision",
+            None if self.config.tpu.matmul_precision == "default"
+            else self.config.tpu.matmul_precision)
         self._rng = rng if rng is not None else jax.random.key(0)
         self._loss_fn = loss_fn if loss_fn is not None else getattr(model, "loss", None)
         if self._loss_fn is None:
@@ -558,7 +561,7 @@ class DeepSpeedEngine:
                     loss, g = jax.value_and_grad(scaled_loss)(p)
                     loss = jax.lax.pmean(loss, qgz_axes)
                     g = jax.tree.map(
-                        lambda x: x.astype(jnp.float32) / n_shards, g)
+                        lambda x: x.astype(acc_dtype) / n_shards, g)
                     g = jax.tree.map(
                         lambda x, d: quantized_grad_reduce_shard(
                             x, d, scatter_axis="fsdp",
@@ -608,7 +611,7 @@ class DeepSpeedEngine:
                     return (l * state.loss_scale).astype(jnp.float32)
                 loss, grads = jax.value_and_grad(scaled_loss)(params_c)
                 grads = constrain(
-                    jax.tree.map(lambda g: g.astype(jnp.float32), grads), gspecs)
+                    jax.tree.map(lambda g: g.astype(acc_dtype), grads), gspecs)
                 losses = (loss / state.loss_scale)[None]
             elif gas == 1:
                 grads, losses = micro(zero_grads, (jax.tree.map(lambda x: x[0], batch), rngs[0]))
@@ -1030,21 +1033,26 @@ class DeepSpeedEngine:
             # topology-free atoms regardless of the saving mesh.  Accepts
             # a universal dir directly, or the checkpoint dir whose
             # <tag>_universal sibling ds_to_universal wrote.
-            from ..checkpoint.universal import load_universal_into_engine
+            from ..checkpoint.universal import (ATOMS_FILE,
+                                                load_universal_into_engine)
             cand = None
-            if os.path.exists(os.path.join(load_dir, "atoms.npz")):
+            if os.path.exists(os.path.join(load_dir, ATOMS_FILE)):
                 cand = load_dir
             else:
                 t = tag or self.checkpoint_engine.read_latest(load_dir)
                 if t is not None:
                     c = os.path.join(load_dir, f"{t}_universal")
-                    if os.path.exists(os.path.join(c, "atoms.npz")):
+                    if os.path.exists(os.path.join(c, ATOMS_FILE)):
                         cand = c
             if cand is None:
                 raise FileNotFoundError(
                     f"checkpoint.load_universal: no universal atoms under "
                     f"{load_dir!r} — run ds_to_universal first")
-            load_universal_into_engine(self, cand)
+            load_universal_into_engine(
+                self, cand,
+                load_optimizer_states=(load_optimizer_states
+                                       and not load_module_only),
+                load_lr_scheduler_states=load_lr_scheduler_states)
             return load_dir, {}
         tag = tag or self.checkpoint_engine.read_latest(load_dir)
         if tag is None:
