@@ -138,4 +138,5 @@ HEAVY_TESTS = frozenset([
     "tests/test_feature_matrix.py::test_sliding_window_with_ring_sequence_parallel",  # 2 engines
     "tests/test_feature_matrix.py::test_cpu_checkpointing_with_zero3_and_host_offload",  # 2 engines + ckpt
     "tests/test_feature_matrix.py::test_moe_with_sequence_parallel_ulysses",  # moe engine
+    "tests/test_feature_matrix.py::test_sliding_window_eviction_with_scheduler_preemption",  # 2 engines
 ])

@@ -125,3 +125,53 @@ def test_moe_with_sequence_parallel_ulysses():
     batch = _llama_batch(eng, model)
     losses = [float(eng.train_batch(batch)) for _ in range(3)]
     assert np.isfinite(losses).all()
+
+
+def test_sliding_window_eviction_with_scheduler_preemption():
+    """Window page eviction AND scheduler preemption compose: a windowed
+    model under a tiny KV pool evicts dead pages as decodes progress,
+    preempts when even that is not enough, and every request completes
+    matching the greedy reference."""
+    import jax.numpy as jnp
+    from flax.core import meta
+    from deepspeed_tpu.inference.v2 import (FastGenScheduler,
+                                            InferenceEngineV2,
+                                            RaggedInferenceModel,
+                                            RaggedInferenceEngineConfig,
+                                            SamplingParams)
+    from deepspeed_tpu.inference.v2.config import StateManagerConfig
+    from deepspeed_tpu.inference.v2.ragged import KVCacheConfig
+
+    def build(num_pages):
+        model_def = LlamaForCausalLM("debug", max_seq_len=256,
+                                     sliding_window=16, dtype=jnp.float32)
+        params = meta.unbox(model_def.init_params(jax.random.key(0)))
+        cfg = model_def.cfg
+        kv = KVCacheConfig(num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
+                           head_dim=cfg.dims_per_head, page_size=4,
+                           num_pages=num_pages, dtype=jnp.float32)
+        eng = InferenceEngineV2(
+            RaggedInferenceModel(cfg, params, kv_config=kv),
+            RaggedInferenceEngineConfig(state_manager=StateManagerConfig(
+                max_tracked_sequences=4, max_ragged_sequence_count=4,
+                max_ragged_batch_size=256)))
+        return eng
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 100, n).tolist() for n in (40, 24, 12)]
+    sp = SamplingParams(max_new_tokens=16, temperature=0.0)
+
+    # roomy pool = ground truth
+    ref_sched = FastGenScheduler(build(num_pages=64))
+    for uid, p in enumerate(prompts):
+        ref_sched.submit(uid, p, sp)
+    ref = ref_sched.run_to_completion()
+
+    # tight pool: total prompt+decode KV would exceed 30 pages x 4
+    # without window eviction + preemption
+    sched = FastGenScheduler(build(num_pages=30))
+    for uid, p in enumerate(prompts):
+        sched.submit(uid, p, sp)
+    outs = sched.run_to_completion()
+    assert {k: v for k, v in sorted(outs.items())} == \
+        {k: v for k, v in sorted(ref.items())}
