@@ -84,6 +84,11 @@ def convert_zero_checkpoint_to_fp32_state_dict(
 
 
 def main(argv=None):
+    # Host-side reconstruction needs no accelerator: pin the CPU platform
+    # BEFORE any backend init so the CLI never blocks on a busy TPU (the
+    # sitecustomize-pinned platform would otherwise claim the chip).
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     argv = argv if argv is not None else sys.argv[1:]
     if len(argv) not in (2, 3):
         print("usage: python -m deepspeed_tpu.checkpoint.zero_to_fp32 "
