@@ -321,6 +321,11 @@ def _train_and_report(jax, n_chips, cpu_fallback=None):
         "steps_per_print": 10 ** 9,
         "tpu": {"remat_policy": REMAT_POLICY},
     }
+    if os.environ.get("BENCH_COMM", "0") != "0":
+        # quantized bucketed gradient wire (CollectiveScheduler); the
+        # scheduler needs unrolled layers on tensor/seq meshes, but the
+        # bench mesh is pure batch axes so scan_layers stays on
+        config["comm_optimization"] = {"enabled": True}
     engine, _, _, _ = dst.initialize(model=model, config=config)
     bs = engine.train_batch_size()
     rng = np.random.default_rng(0)
@@ -357,6 +362,21 @@ def _train_and_report(jax, n_chips, cpu_fallback=None):
         "remat_policy": REMAT_POLICY,
         "micro_bs": MICRO_BS,
     }
+    # comm accounting: lets the bench trajectory attribute future wins
+    # to wire reduction vs compute.  Exact when the CollectiveScheduler
+    # runs (static bucket plan); estimated for the compiler-psum path.
+    comm = engine.comm_stats()
+    gas = engine.gradient_accumulation_steps()
+    if comm is not None:
+        result["comm_bytes_per_step"] = comm["comm_bytes_per_step"]
+        result["comm_quantized_fraction"] = comm["comm_quantized_fraction"]
+        result["comm_buckets"] = comm["bucket_count"]
+    else:
+        batch_world = engine.topology.batch_shard_size
+        result["comm_bytes_per_step"] = (
+            8 * int(n_params) * gas if batch_world > 1 else 0)
+        result["comm_quantized_fraction"] = 0.0
+        result["comm_bytes_estimated"] = True
     if cpu_fallback is not None:
         # loud, unmistakable labeling: this is NOT a TPU measurement
         result["metric"] = ("CPU-FALLBACK (TPU unavailable) " +

@@ -9,6 +9,24 @@ jitted fused train steps instead of stream-scheduled CUDA kernels.
 
 from .version import __version__  # noqa: F401
 
+import os as _os
+
+import jax as _jax
+
+# Sharding-invariant RNG: with the legacy (non-partitionable) threefry,
+# the SAME key produces DIFFERENT values under different out_shardings —
+# so a model initialized on a {fsdp:8} mesh differs from the identical
+# model on {data:2, fsdp:4}, breaking cross-topology reproducibility
+# (and the MiCS == plain-stage3 parity the reference guarantees).
+# Set at IMPORT so every draw in the process agrees (flipping it at
+# engine construction would make a script's jax.random values depend on
+# whether an engine was built yet).  This changes jax.random streams vs
+# the legacy impl; opt out with DS_TPU_PARTITIONABLE_RNG=0 if bitwise
+# continuity with pre-existing seeds matters more than cross-topology
+# init reproducibility.
+if _os.environ.get("DS_TPU_PARTITIONABLE_RNG", "1") != "0":
+    _jax.config.update("jax_threefry_partitionable", True)
+
 from . import comm  # noqa: F401
 from .accelerator import get_accelerator  # noqa: F401
 from .parallel.topology import MeshTopology, TopologyConfig  # noqa: F401

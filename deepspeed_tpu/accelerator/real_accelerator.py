@@ -26,6 +26,16 @@ def get_accelerator() -> DeepSpeedAccelerator:
     if name is not None and name not in SUPPORTED_ACCELERATOR_LIST:
         raise ValueError(
             f"DS_ACCELERATOR={name!r} not in {SUPPORTED_ACCELERATOR_LIST}")
+    if name == "cpu":
+        # An explicit CPU request must NEVER initialize the JAX backend:
+        # jax.default_backend() would touch (and possibly hang on) a TPU
+        # held by another process — the exact situation DS_ACCELERATOR=cpu
+        # exists to avoid.
+        from .cpu_accelerator import CPU_Accelerator
+        _accelerator = CPU_Accelerator()
+        logger.info("Setting accelerator to %s (explicit, backend "
+                    "untouched)", _accelerator.device_name())
+        return _accelerator
     import jax
     backend = jax.default_backend()
     if name is None:

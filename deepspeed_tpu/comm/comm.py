@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.jax_compat import axis_size as _axis_size
 from ..utils.logging import logger
 
 AxisName = Union[str, Sequence[str]]
@@ -140,7 +141,7 @@ def axis_index(axis_name: AxisName) -> jax.Array:
 
 
 def axis_size(axis_name: str) -> int:
-    return lax.axis_size(axis_name)
+    return _axis_size(axis_name)
 
 
 # ---------------------------------------------------------------------------
@@ -263,6 +264,15 @@ def monitored_barrier(timeout: float = 300.0) -> float:
     return dt
 
 
+def record_bucket_plan(stats: dict) -> None:
+    """Feed the CollectiveScheduler's static bucket plan into the comms
+    logger (no-op when the logger is not configured).  The plan is exact
+    — bucket boundaries are static — so the summary's gradient-wire
+    volume needs no tracing hooks."""
+    if _comms_logger is not None and _comms_logger.enabled:
+        _comms_logger.record_bucket_plan(stats)
+
+
 def log_summary(show_straggler: bool = False) -> str:
     """Print + return the comms-volume summary (reference comm.py
     log_summary; straggler analysis is meaningless under XLA's fused
@@ -309,7 +319,7 @@ def scatter(tensor: jax.Array, axis_name: AxisName, src: int = 0,
     idx = lax.axis_index(axis_name)
     masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
     full = lax.psum(masked, axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if full.shape[axis] % n != 0:
         raise ValueError(
             f"scatter: dim {axis} ({full.shape[axis]}) not divisible by "

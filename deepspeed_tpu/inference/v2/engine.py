@@ -256,8 +256,10 @@ class InferenceEngineV2:
             idxs = groups[q_bucket]
             sub_descs = [descs[i] for i in idxs]
             sub_tokens = [np.asarray(batch_tokens[i]) for i in idxs]
-            batch = build_batch(sub_descs, sub_tokens,
-                                self._model.kv_config.page_size)
+            batch = build_batch(
+                sub_descs, sub_tokens, self._model.kv_config.page_size,
+                fresh_supported=getattr(self._model, "_fresh_attention",
+                                        None) is not None)
             logits, self._state.kv_cache.data = self._model.forward(
                 batch, self._state.kv_cache.data)
             for row, i in enumerate(idxs):

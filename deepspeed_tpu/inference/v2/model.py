@@ -242,6 +242,13 @@ class RaggedInferenceModel:
         return logits, kv
 
     def _get_step(self, key) -> Callable:
+        if getattr(self, "_fresh_attention", None) is None \
+                and len(key) > 3 and key[3]:
+            # no fresh-prefill implementation (ALiBi): the flag is inert,
+            # so normalize the cache key to the False variant the
+            # precompiled lattice contains (direct-forward callers may
+            # hand us a batch built without fresh_supported=False)
+            key = key[:3] + (False,)
         fn = self._step_cache.get(key)
         if fn is None:
             if getattr(self, "strict_shapes", False):

@@ -68,11 +68,20 @@ def build_batch(seqs: Sequence[SequenceDescriptor],
                 tokens: Sequence[np.ndarray],
                 page_size: int,
                 min_slots: int = 1,
-                min_pages: int = 8) -> RaggedBatch:
+                min_pages: int = 8,
+                fresh_supported: bool = True) -> RaggedBatch:
     """Pack (descriptor, new-token) pairs into a bucketed RaggedBatch.
 
     Callers must already have reserved KV pages on each descriptor
     (engine's ``maybe_allocate_kv``) and called ``pre_forward``.
+
+    ``fresh_supported``: whether the model has a dedicated fresh-prefill
+    attention path.  Models without one (ALiBi) ignore the flag, so it
+    must be coerced False here — otherwise a fresh prefill forms a
+    ``(S, Q, P, True)`` step-cache key the precompiled lattice never
+    contains (``precompile`` only lowers the True variant when the model
+    has ``_fresh_attention``), spuriously raising under ``strict_shapes``
+    or recompiling on the request path.
     """
     n = len(seqs)
     assert n == len(tokens) and n >= 1
@@ -92,6 +101,7 @@ def build_batch(seqs: Sequence[SequenceDescriptor],
         start_pos[i] = sd.seen_tokens
         page_table[i] = sd.page_table(P)
         uids.append(sd.uid)
-    fresh = Q > 1 and all(s.seen_tokens == 0 for s in seqs)
+    fresh = fresh_supported and Q > 1 and all(s.seen_tokens == 0
+                                              for s in seqs)
     return RaggedBatch(token_ids, q_lens, start_pos, page_table, uids,
                        fresh=fresh)

@@ -205,7 +205,25 @@ def _norm_init(cfg: TransformerConfig, dim: int):
 # ---------------------------------------------------------------------------
 
 def _constrain(x: jax.Array, *spec) -> jax.Array:
-    """Sharding constraint that degrades to no-op outside a mesh context."""
+    """Sharding constraint that degrades to no-op outside a mesh context.
+
+    Inside a ``shard_map`` region (e.g. the CollectiveScheduler's
+    batch-axes-manual backward), entries naming manually-bound axes are
+    pruned — those dims are already physically sharded by the region —
+    while entries over still-automatic axes (tensor/seq under
+    partial-auto) keep guiding GSPMD."""
+    from ..utils.jax_compat import manual_axis_names
+    manual = manual_axis_names()
+    if manual:
+        def prune(entry):
+            if entry is None:
+                return None
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            kept = tuple(a for a in axes if a not in manual)
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+        spec = tuple(prune(e) for e in spec)
+        if all(e is None for e in spec):
+            return x
     try:
         return jax.lax.with_sharding_constraint(x, P(*spec))
     except (ValueError, RuntimeError):
@@ -309,7 +327,7 @@ def flash_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v) -> jax.Ar
 
     mesh = _ambient_mesh()
     if mesh is not None:
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
         batch_axes = tuple(a for a in BATCH if a in mesh.axis_names)
         head_axes = tuple(a for a in ("seq", "tensor") if a in mesh.axis_names)
         head_shards = 1
@@ -350,7 +368,7 @@ def ring_dot_product_attention(cfg: TransformerConfig, q, kv_k, kv_v
         vf = jnp.repeat(vf, groups, axis=1)
 
     mesh = _ambient_mesh()
-    from jax import shard_map
+    from ..utils.jax_compat import shard_map
     batch_axes = tuple(a for a in BATCH if a in mesh.axis_names)
     head_axes = _divisible_head_axes(qf.shape[1], ("tensor",))
     spec = P(batch_axes or None, head_axes or None, "seq", None)

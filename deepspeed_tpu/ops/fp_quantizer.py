@@ -12,7 +12,8 @@ quantization here is literally ``scale -> convert_element_type`` (RNE in
 hardware) and storage is a real fp8/fp4 buffer:
 
 * ``fp8_e4m3`` / ``fp8_e5m2`` — native storage and native dot support.
-* ``fp4_e2m1``                — native storage (jnp.float4_e2m1fn).
+* ``fp4_e2m1``                — native storage (jnp.float4_e2m1fn)
+  when the installed JAX exposes it; else snapped-to-grid fp8 storage.
 * ``fp6_e3m2`` / ``fp6_e2m3`` — JAX has no fp6 buffer type; values are
   snapped to the exact fp6 grid but stored as fp8_e4m3 (every fp6 value
   is exactly representable there).  Numerics match the reference's fp6;
@@ -38,8 +39,18 @@ _FORMATS = {
     "fp8_e5m2": (jnp.float8_e5m2, 57344.0, (5, 2)),
     "fp6_e3m2": (jnp.float8_e4m3fn, 28.0, (3, 2)),
     "fp6_e2m3": (jnp.float8_e4m3fn, 7.5, (2, 3)),
-    "fp4_e2m1": (jnp.float4_e2m1fn, 6.0, (2, 1)),
+    # storage is native fp4 when this JAX exposes it; otherwise values
+    # snap to the exact e2m1 grid but store as fp8_e4m3 (every fp4 value
+    # is exactly representable there — same fallback the fp6 formats use)
+    "fp4_e2m1": (getattr(jnp, "float4_e2m1fn", jnp.float8_e4m3fn),
+                 6.0, (2, 1)),
 }
+
+#: formats whose values must be grid-snapped because their storage dtype
+#: is WIDER than the format (fp6 always; fp4 when jnp lacks a 4-bit type)
+_SNAP_FORMATS = tuple(
+    f for f in ("fp6_e3m2", "fp6_e2m3", "fp4_e2m1")
+    if f in _FORMATS and jnp.finfo(_FORMATS[f][0]).bits > 6)
 
 #: formats quantize_channelwise/quantize accept (int8 is handled inline)
 SUPPORTED_FORMATS = ("int8",) + tuple(_FORMATS)
@@ -99,7 +110,7 @@ def quantize(x: jax.Array, group_size: int = 512,
     absmax = jnp.max(jnp.abs(x2), axis=1, keepdims=True)
     scale = jnp.maximum(absmax, 1e-12) / max_mag
     y = x2 / scale
-    if fmt.startswith("fp6"):
+    if fmt in _SNAP_FORMATS:
         y = _snap_to_grid(y, _fp6_grid_cached(fmt))
     q = y.astype(store_dtype)
     return q, scale[:, 0], pad
@@ -183,7 +194,7 @@ def quantize_channelwise(w: jax.Array, fmt: str = "fp8_e4m3",
     store_dtype, max_mag, _ = _FORMATS[fmt]
     scale = jnp.maximum(absmax, 1e-12) / max_mag
     y = w.astype(jnp.float32) / scale
-    if fmt.startswith("fp6"):
+    if fmt in _SNAP_FORMATS:
         y = _snap_to_grid(y, _fp6_grid_cached(fmt))
     return {"q": y.astype(store_dtype), "scale": scale.astype(jnp.float32)}
 

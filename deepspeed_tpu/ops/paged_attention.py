@@ -33,6 +33,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed TPUCompilerParams -> CompilerParams across pallas releases
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 
@@ -265,7 +269,7 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((S, K, G, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(page_table.astype(jnp.int32), start_pos.astype(jnp.int32), *inputs)

@@ -1,0 +1,7 @@
+"""Runtime communication subsystems: compressed-communication optimizers
+(1-bit Adam/LAMB numerics) and the CollectiveScheduler (bucketed,
+quantized, overlap-scheduled gradient collectives)."""
+
+from .collective_scheduler import Bucket, CollectiveScheduler
+
+__all__ = ["Bucket", "CollectiveScheduler"]

@@ -68,6 +68,12 @@ class TrainState(struct.PyTreeNode):
     good_steps: jax.Array           # int32 consecutive non-overflow steps
     skipped_steps: jax.Array        # int32 total skipped (overflow) steps
     hysteresis: jax.Array           # int32 remaining tolerated overflows
+    # CollectiveScheduler error-feedback residuals: [world, E] fp32 per
+    # batch shard (() when the quantized wire or error feedback is off).
+    # Checkpointed with the state; universal-checkpoint load ignores it
+    # (atoms cover params/opt only) and plain load falls back to zeros
+    # when restoring a checkpoint written without it.
+    comm_residuals: Any = ()
 
 
 @dataclasses.dataclass
@@ -208,6 +214,7 @@ class DeepSpeedEngine:
             init_params = self._init_params()  # sets self._abstract_params
         self._maybe_enable_compression()
         self._maybe_enable_offload()
+        self.comm_scheduler = self._build_comm_scheduler()
         if self.offload is not None:
             # masters come from the fp32 initializer output, BEFORE the
             # device copy is narrowed to compute dtype
@@ -230,6 +237,10 @@ class DeepSpeedEngine:
         self.monitor = self._build_monitor()
         if self.config.comms_logger.enabled:
             dist.configure_comms_logger(verbose=self.config.comms_logger.verbose)
+            if self.comm_scheduler is not None:
+                dist.record_bucket_plan(
+                    self.comm_scheduler.stats(
+                        self.gradient_accumulation_steps()))
         self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn) \
             if training_data is not None else None
         self.checkpoint_engine = self._build_checkpoint_engine()
@@ -353,6 +364,83 @@ class DeepSpeedEngine:
             optax.masked(self.optimizer, mask),
             optax.masked(optax.set_to_zero(), inv_mask))
 
+    def _build_comm_scheduler(self):
+        """Build the CollectiveScheduler (bucketed/quantized/overlapped
+        gradient collectives) when the config asks for it and the mesh
+        supports it; None means gradients reduce via the compiler's
+        psum exactly as before (bit-identical path)."""
+        cfg = self.config
+        comm = cfg.comm_optimization
+        # legacy ZeRO++ qgZ flag routes through the scheduler now
+        legacy_qgz = (self.zero_stage >= 2
+                      and cfg.zero_optimization.zero_quantized_gradients)
+        if not (comm.enabled or legacy_qgz):
+            return None
+        if getattr(self, "_fused_microbatches", False):
+            logger.warning(
+                "comm_optimization: pipeline (fused micro-batch) engines "
+                "reduce inside the pipelined program; scheduler disabled")
+            return None
+        mesh = self.topology.mesh
+        sizes = {a: mesh.shape.get(a, 1) for a in mesh.axis_names}
+        manual = tuple(a for a in ("data", "fsdp") if sizes.get(a, 1) > 1)
+        if not manual:
+            logger.warning(
+                "comm_optimization: no data/fsdp axis larger than 1 — "
+                "nothing to reduce; scheduler disabled")
+            return None
+        if any(sizes.get(a, 1) > 1 for a in ("expert", "hpz", "pipe")):
+            logger.warning(
+                "comm_optimization: expert/hpz/pipe meshes keep the "
+                "compiler psum (their grad reduction is not a plain "
+                "batch-axes sum); scheduler disabled")
+            return None
+        others = any(sizes.get(a, 1) > 1 for a in ("tensor", "seq"))
+        if others and getattr(getattr(self.module, "cfg", None),
+                              "scan_layers", False):
+            # partial-auto regions (manual batch axes + auto tensor/seq)
+            # miscompile a lax.scan over layers on this XLA version
+            # (spmd partitioner manual-subgroup check); unrolled layers
+            # work — the user picks which to keep
+            logger.warning(
+                "comm_optimization: tensor/seq meshes + tpu.scan_layers "
+                "miscompile in partial-auto shard_map regions on this "
+                "XLA version — set tpu.scan_layers=false to keep the "
+                "scheduler; falling back to compiler psum")
+            return None
+        if others and not comm.enabled:
+            # the legacy qgZ flag keeps its seed semantics: pure
+            # batch-axes meshes only.  Opt into comm_optimization
+            # explicitly for tensor/seq meshes.
+            logger.warning(
+                "zero_quantized_gradients: mesh has tensor/seq axes; "
+                "enable comm_optimization explicitly for the quantized "
+                "wire on such meshes — falling back to compiler psum")
+            return None
+        if legacy_qgz and not comm.enabled:
+            # seed qgZ semantics: quantized per-micro-batch reduction,
+            # NO persistent error feedback (the seed path kept no
+            # residual state — silently adding a full-gradient fp32
+            # buffer per rank could OOM a previously-fitting model).
+            # Opt into comm_optimization explicitly for error feedback.
+            comm = comm.model_copy(update={"quantize": True,
+                                           "error_feedback": False,
+                                           "overlap": True})
+        acc_dtype = (jnp.float32 if cfg.bf16.accumulate_grads_in_fp32
+                     else self.compute_dtype)
+        from .comm.collective_scheduler import CollectiveScheduler
+        abstract_grads = jax.eval_shape(unbox, self._abstract_params)
+        gspecs = self.partitioner.tree_grad_specs(self._abstract_params)
+        return CollectiveScheduler(self.topology, comm, abstract_grads,
+                                   gspecs, acc_dtype=acc_dtype)
+
+    def comm_stats(self) -> Optional[dict]:
+        """Static per-step wire accounting from the CollectiveScheduler
+        (None when gradients reduce via the compiler psum)."""
+        if self.comm_scheduler is None:
+            return None
+        return self.comm_scheduler.stats(self.gradient_accumulation_steps())
+
     def _traced_lr(self, count):
         sched = self._schedule
         try:
@@ -407,7 +495,9 @@ class DeepSpeedEngine:
                 loss_scale=jnp.asarray(self._initial_loss_scale(), jnp.float32),
                 good_steps=jnp.zeros((), jnp.int32),
                 skipped_steps=jnp.zeros((), jnp.int32),
-                hysteresis=jnp.asarray(self.config.fp16.hysteresis, jnp.int32))
+                hysteresis=jnp.asarray(self.config.fp16.hysteresis, jnp.int32),
+                comm_residuals=(self.comm_scheduler.init_residuals()
+                                if self.comm_scheduler is not None else ()))
 
         abstract = jax.eval_shape(make_state, params)
         state_sh = self._state_shardings(abstract, master_sh)
@@ -443,7 +533,9 @@ class DeepSpeedEngine:
             step=rep,
             params=master_sh,
             opt_state=opt_sh,
-            loss_scale=rep, good_steps=rep, skipped_steps=rep, hysteresis=rep)
+            loss_scale=rep, good_steps=rep, skipped_steps=rep, hysteresis=rep,
+            comm_residuals=(self.comm_scheduler.residual_sharding()
+                            if self.comm_scheduler is not None else ()))
 
     def _initial_loss_scale(self) -> float:
         if not self._fp16_enabled:
@@ -518,64 +610,11 @@ class DeepSpeedEngine:
         # batch in one pipelined evaluation (no outer micro-batch scan).
         fused_mb = getattr(self, "_fused_microbatches", False)
 
-        # ZeRO++ qgZ REAL-WIRE path (reference all_to_all_quant_reduce,
-        # runtime/comm/coalesced_collectives.py:31): the whole
-        # loss+backward runs in a shard_map manual region over the
-        # batch axes, so the gradient reduction is OUR collective — an
-        # int8 hierarchical reduce-scatter (fsdp) + int8 allreduce
-        # (data) — instead of the compiler-inserted fp32 psum.  Feasible
-        # when the mesh has only batch-ish axes (no tp/sp/pp/ep manual
-        # collectives inside the model) — the pure-DP regime the
-        # reference's 1-bit/qgZ optimizers target.  Stage 3 works but
-        # gathers full params at the region boundary (per-layer JIT
-        # gathering does not cross into Manual mode).
-        mesh_sizes = {a: mesh.shape.get(a, 1) for a in mesh.axis_names}
-        qgz_axes = tuple(a for a in ("data", "fsdp")
-                         if mesh_sizes.get(a, 1) > 1)
-        qgz_wire = (self.zero_stage >= 2
-                    and cfg.zero_optimization.zero_quantized_gradients
-                    and not fused_mb and qgz_axes
-                    and all(mesh_sizes.get(a, 1) == 1
-                            for a in ("tensor", "seq", "pipe", "expert",
-                                      "hpz")))
-        if qgz_wire:
-            from jax import shard_map as _shard_map
-            from ..ops.quantization import quantized_grad_reduce_shard
-
-            def _fsdp_dim(spec):
-                for i, e in enumerate(spec):
-                    axes = e if isinstance(e, tuple) else ((e,) if e else ())
-                    if "fsdp" in axes:
-                        return i
-                return None
-
-            gdims = jax.tree.map(_fsdp_dim, gspecs,
-                                 is_leaf=lambda s: isinstance(s, P))
-            n_shards = int(np.prod([mesh_sizes[a] for a in qgz_axes]))
-
-            def _qgz_value_and_grad(p, mb, mb_rng, scale):
-                def region(p, mb, mb_rng, scale):
-                    def scaled_loss(pp):
-                        return (loss_fn(pp, mb, mb_rng)
-                                * scale).astype(jnp.float32)
-                    loss, g = jax.value_and_grad(scaled_loss)(p)
-                    loss = jax.lax.pmean(loss, qgz_axes)
-                    g = jax.tree.map(
-                        lambda x: x.astype(acc_dtype) / n_shards, g)
-                    g = jax.tree.map(
-                        lambda x, d: quantized_grad_reduce_shard(
-                            x, d, scatter_axis="fsdp",
-                            replica_axes=("data",)),
-                        g, gdims)
-                    return loss, g
-                batch_specs = jax.tree.map(
-                    lambda x: P(BATCH_AXES) if np.ndim(x) else P(), mb)
-                return _shard_map(
-                    region, mesh=mesh,
-                    in_specs=(jax.tree.map(lambda _: P(), p),
-                              batch_specs, P(), P()),
-                    out_specs=(P(), gspecs),
-                    check_vma=False)(p, mb, mb_rng, scale)
+        # Gradient-collective scheduler (runtime/comm/collective_scheduler):
+        # bucketed int8 wire + error feedback + per-micro-batch overlap,
+        # generalizing the old inline qgZ special case.  None => the
+        # compiler-inserted psum reduces gradients exactly as before.
+        sched = self.comm_scheduler
 
         def step_fn(state: TrainState, batch, rng):
             # ZeRO: compute params = cast(master) re-sharded to param layout.
@@ -585,24 +624,55 @@ class DeepSpeedEngine:
 
             def micro(carry, xs):
                 mb, mb_rng = xs
-                if qgz_wire:
-                    loss, grads = _qgz_value_and_grad(
-                        params_c, mb, mb_rng, state.loss_scale)
-                else:
-                    def scaled_loss(p):
-                        l = loss_fn(p, mb, mb_rng)
-                        return (l * state.loss_scale).astype(jnp.float32)
-                    loss, grads = jax.value_and_grad(scaled_loss)(params_c)
-                    grads = jax.tree.map(
-                        lambda g: g.astype(acc_dtype), grads)
+
+                def scaled_loss(p):
+                    l = loss_fn(p, mb, mb_rng)
+                    return (l * state.loss_scale).astype(jnp.float32)
+                loss, grads = jax.value_and_grad(scaled_loss)(params_c)
+                grads = jax.tree.map(
+                    lambda g: g.astype(acc_dtype), grads)
                 # fp32 accumulation (reference bf16_optimizer immediate
                 # hp-grad accumulation), born reduce-scattered for stage>=2
                 grads = constrain(grads, gspecs)
                 carry = jax.tree.map(jnp.add, carry, grads)
                 return carry, loss / state.loss_scale
 
-            zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, acc_dtype), params_c)
+            def micro_sched(carry, xs):
+                # backward in a batch-axes-manual region => unreduced
+                # per-shard grads; the scheduler owns the reduction wire
+                mb, mb_rng = xs
+                loss, flat_local, direct = sched.backward(
+                    loss_fn, params_c, mb, mb_rng, state.loss_scale)
+                if sched.overlap:
+                    # reduce THIS micro-batch's buckets now: their
+                    # collectives overlap the remaining buckets' quantize
+                    # work and the next micro-batch's backward
+                    acc, resid = carry
+                    flat_red, resid = sched.reduce(flat_local, resid,
+                                                   state.loss_scale)
+                    g = constrain(sched.combine(flat_red, direct), gspecs)
+                    acc = jax.tree.map(jnp.add, acc, g)
+                    return (acc, resid), loss / state.loss_scale
+                # accumulate unreduced; one bucketed reduction at the
+                # gradient-accumulation boundary
+                acc_flat, acc_direct = carry
+                acc_flat = acc_flat + flat_local
+                acc_direct = jax.tree.map(jnp.add, acc_direct, direct)
+                return (acc_flat, acc_direct), loss / state.loss_scale
+
+            if sched is None:
+                zero_carry = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, acc_dtype), params_c)
+                micro_fn = micro
+            elif sched.overlap:
+                zero_carry = (jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, acc_dtype), params_c),
+                    state.comm_residuals)
+                micro_fn = micro_sched
+            else:
+                zero_carry = (sched.zero_flat(), sched.zero_direct())
+                micro_fn = micro_sched
+
             rngs = jax.random.split(rng, gas)
             if fused_mb:
                 # loss is already a mean over every micro-batch token
@@ -614,16 +684,41 @@ class DeepSpeedEngine:
                     jax.tree.map(lambda g: g.astype(acc_dtype), grads), gspecs)
                 losses = (loss / state.loss_scale)[None]
             elif gas == 1:
-                grads, losses = micro(zero_grads, (jax.tree.map(lambda x: x[0], batch), rngs[0]))
+                carry, losses = micro_fn(
+                    zero_carry, (jax.tree.map(lambda x: x[0], batch), rngs[0]))
                 losses = losses[None]
             else:
-                grads, losses = jax.lax.scan(micro, zero_grads, (batch, rngs))
+                carry, losses = jax.lax.scan(micro_fn, zero_carry,
+                                             (batch, rngs))
+            new_residuals = state.comm_residuals
+            if fused_mb:
+                pass  # grads already reduced by the fused evaluation
+            elif sched is None:
+                grads = carry
+            elif sched.overlap:
+                grads, new_residuals = carry
+            else:
+                acc_flat, acc_direct = carry
+                flat_red, new_residuals = sched.reduce(
+                    acc_flat, state.comm_residuals, state.loss_scale)
+                grads = constrain(sched.combine(flat_red, acc_direct),
+                                  gspecs)
             inv = 1.0 / ((1 if fused_mb else gas) * state.loss_scale)
             grads = jax.tree.map(lambda g: g * inv, grads)
 
             # global grad norm (over ALL shards; XLA handles cross-device sum)
             gnorm = optax.global_norm(grads)
             finite = jnp.isfinite(gnorm)
+            if sched is not None:
+                if fp16 and jax.tree.leaves(new_residuals):
+                    # an overflow step quantizes inf gradients (absmax inf
+                    # -> NaN payload): committing that error-feedback
+                    # update would poison every later step's buckets, so
+                    # keep the previous residuals on overflow
+                    new_residuals = jax.tree.map(
+                        lambda n, o: jnp.where(finite, n, o),
+                        new_residuals, state.comm_residuals)
+                state = state.replace(comm_residuals=new_residuals)
             if clip > 0:
                 scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * scale, grads)
@@ -1057,9 +1152,52 @@ class DeepSpeedEngine:
         tag = tag or self.checkpoint_engine.read_latest(load_dir)
         if tag is None:
             return None, {}
-        state, client_state = self.checkpoint_engine.load(
-            load_dir, tag, self.state, self._state_shardings_cache,
-            module_only=load_module_only or not load_optimizer_states)
+        try:
+            state, client_state = self.checkpoint_engine.load(
+                load_dir, tag, self.state, self._state_shardings_cache,
+                module_only=load_module_only or not load_optimizer_states)
+        except Exception as load_err:
+            if self.comm_scheduler is None or not jax.tree.leaves(
+                    self.state.comm_residuals):
+                raise
+            # If the checkpoint actually CONTAINS residuals, the failure
+            # is something else — retrying without them would silently
+            # discard saved state and mask the real cause.
+            state_dir = os.path.join(load_dir, tag, "state")
+            try:
+                has_saved_residuals = any(
+                    "comm_residuals" in name
+                    for name in os.listdir(state_dir))
+            except OSError:
+                has_saved_residuals = False
+            if has_saved_residuals:
+                raise
+            # checkpoint predates the CollectiveScheduler (no
+            # comm_residuals leaf at all): restore everything else; the
+            # residuals are re-zeroed below — feedback history is an
+            # accuracy refinement, not load-bearing state
+            logger.warning(
+                "checkpoint load with comm_residuals template failed "
+                "(%s); retrying without the residual leaf", load_err)
+            template = self.state.replace(comm_residuals=())
+            shardings = self._state_shardings_cache.replace(
+                comm_residuals=())
+            state, client_state = self.checkpoint_engine.load(
+                load_dir, tag, template, shardings,
+                module_only=load_module_only or not load_optimizer_states)
+            state = state.replace(comm_residuals=())
+        if self.comm_scheduler is not None and \
+                jax.tree.leaves(self.state.comm_residuals) and \
+                not jax.tree.leaves(state.comm_residuals):
+            # checkpoint carried no error-feedback residuals (saved
+            # pre-scheduler or with the wire disabled): start from zero
+            logger.warning(
+                "checkpoint %s has no comm_residuals — zero-initializing "
+                "error feedback", tag)
+            with self.topology.mesh:
+                state = state.replace(comm_residuals=jax.device_put(
+                    self.comm_scheduler.init_residuals(),
+                    self.comm_scheduler.residual_sharding()))
         self.state = state
         if self.offload is not None:
             off_path = os.path.join(
@@ -1112,9 +1250,11 @@ class DeepSpeedEngine:
     # Reference API compatibility surface (engine.py exposes ~100 config
     # accessors + small state queries that user scripts and the
     # autotuner read; each one maps onto our pydantic config or engine
-    # state.  Torch-mechanics methods with no TPU meaning — the manual
-    # allreduce-bucket family, graph harvesting, amp — are deliberately
-    # absent: grads reduce inside the jitted step.)
+    # state.  Torch-mechanics methods with no TPU meaning — graph
+    # harvesting, amp — are deliberately absent: grads reduce inside the
+    # jitted step, and explicit bucketing/quantization/overlap of that
+    # reduction is the CollectiveScheduler's job (comm_optimization
+    # config block), not an imperative method family.)
     # ------------------------------------------------------------------
 
     def train(self, mode: bool = True):

@@ -49,6 +49,7 @@ from flax.core import meta
 from jax.sharding import PartitionSpec as P
 
 from ...models import transformer as tfm
+from ...utils.jax_compat import shard_map as _compat_shard_map
 from ...utils.logging import log_dist
 from ..engine import DeepSpeedEngine
 from .module import PipelineModule
@@ -70,7 +71,8 @@ def gpipe_spmd(mesh,
                first_fn: Optional[Callable] = None,
                last_fn: Optional[Callable] = None,
                edge_params: Any = None,
-               stage_aux: bool = False) -> Any:
+               stage_aux: bool = False,
+               consts_batched: Any = None) -> Any:
     """Differentiable pipelined map over the 'pipe' mesh axis.
 
     ``stage_params`` leaves carry a leading stage dim (global size S,
@@ -135,16 +137,62 @@ def gpipe_spmd(mesh,
     param_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
     perm = [(i, (i + 1) % S) for i in range(S)]
 
+    # Batch-parallel axes go MANUAL alongside 'pipe' (fully-manual
+    # region): differentiating a PARTIAL-auto region hits hard
+    # partitioner bugs on this JAX version (scalar-residual _SpecError,
+    # unsupported PartitionId — see utils/jax_compat.py notes), while a
+    # fully-manual region differentiates fine.  Leaves of x/consts whose
+    # dim 1 is the global micro-batch width shard over these axes; the
+    # activation's dim 0 is that batch dim by the first_fn/stage_fn
+    # contract.  Tensor/seq axes (if any) stay auto — grad through that
+    # combination remains unsupported on this JAX version.
+    batch_axes = tuple(a for a in ("data", "expert", "fsdp", "hpz")
+                       if mesh.shape.get(a, 1) > 1)
+    x0 = jax.tree.leaves(x)[0]
+    b_global = x0.shape[1] if x0.ndim >= 2 else None
+    n_bshards = int(np.prod([mesh.shape[a] for a in batch_axes])) \
+        if batch_axes else 1
+    batch_manual = bool(batch_axes) and b_global is not None \
+        and b_global % n_bshards == 0
+    if not batch_manual:
+        batch_axes, n_bshards = (), 1
+
+    def _batched(leaf) -> bool:
+        return (batch_manual and np.ndim(leaf) >= 2
+                and np.shape(leaf)[1] == b_global)
+
+    # Which consts leaves carry the batch at dim 1?  Callers that know
+    # (PipelineEngine.loss) pass ``consts_batched`` explicitly — the
+    # dim-1-width heuristic mis-shards any replicated const whose second
+    # dim coincidentally equals the micro-batch width (e.g. an [s, s]
+    # table with s == b).
+    if consts_batched is None:
+        consts_flags = jax.tree.map(_batched, consts)
+    else:
+        consts_flags = jax.tree.map(
+            lambda _a, f: bool(f) and batch_manual, consts, consts_batched)
+
+    def _local_sds(a, f):
+        """ShapeDtypeStruct with the batch dim localized to one shard."""
+        shape = tuple(a.shape)
+        if f:
+            shape = (shape[0], shape[1] // n_bshards) + shape[2:]
+        return jax.ShapeDtypeStruct(shape, a.dtype)
+
     # shape inference OUTSIDE the Manual-mode region (eval_shape inside
-    # shard_map trips on mixed Manual/Auto mesh contexts)
+    # shard_map trips on mixed Manual/Auto mesh contexts), on LOCAL
+    # (per-batch-shard) micro-batch shapes
     x0_sds = jax.tree.map(
-        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), x)
+        lambda a: jax.ShapeDtypeStruct(
+            _local_sds(a, _batched(a)).shape[1:], a.dtype), x)
+    consts_sds = jax.tree.map(_local_sds, consts, consts_flags)
     if first_fn is None:
         act_sds = jax.tree.leaves(x0_sds)[0]
     else:
-        act_sds = jax.eval_shape(first_fn, edge_params, x0_sds, consts, 0)
+        act_sds = jax.eval_shape(first_fn, edge_params, x0_sds,
+                                 consts_sds, 0)
         act_sds = jax.ShapeDtypeStruct(act_sds.shape, act_sds.dtype)
-    acc_sds = (jax.eval_shape(last_fn, edge_params, act_sds, consts, 0)
+    acc_sds = (jax.eval_shape(last_fn, edge_params, act_sds, consts_sds, 0)
                if last_fn is not None else None)
     # On XLA-CPU, x and edge_params cross the region boundary in fp32:
     # the shard_map transpose psums the cotangent of a replicated input
@@ -164,16 +212,43 @@ def gpipe_spmd(mesh,
     x_in = _to_f32(x)
     edge_dtypes = jax.tree.map(lambda a: a.dtype, edge_params)
     edge_in = _to_f32(edge_params)
+    # Partial-auto shard_map on this JAX version appends the auto axes
+    # to every input's dim-0 names, so a RANK-0 leaf trips the spec
+    # check (_SpecError on float32[]).  Lift scalars to rank 1 at the
+    # boundary and unlift inside.
+    consts_ndims = jax.tree.map(jnp.ndim, consts)
+    consts_in = jax.tree.map(
+        lambda a, n: jnp.asarray(a)[None] if n == 0 else a,
+        consts, consts_ndims)
+
+    def _data_spec(flag: bool) -> P:
+        return P(None, batch_axes) if flag else P()
+
+    if last_fn is None and batch_manual:
+        # stack mode: [1, M, b_local, ...] output keeps its batch shard
+        out_specs = (P(PIPE_AXIS, None, batch_axes), P(PIPE_AXIS))
+    else:
+        # reduce-mode accumulators are psum'd over every manual axis
+        out_specs = P(PIPE_AXIS)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        _compat_shard_map, mesh=mesh,
         in_specs=(param_specs, jax.tree.map(lambda _: P(), edge_params),
-                  P(), jax.tree.map(lambda _: P(), consts)),
-        out_specs=P(PIPE_AXIS),
-        axis_names=frozenset({PIPE_AXIS}),
+                  jax.tree.map(lambda a: _data_spec(_batched(a)), x),
+                  jax.tree.map(lambda _a, f: _data_spec(f),
+                               consts_in, consts_flags)),
+        out_specs=out_specs,
+        # only axes that actually have devices go auto: pipe-(x batch)
+        # meshes stay FULLY manual, which this JAX version can
+        # differentiate (partial-auto grad hits known partitioner bugs)
+        auto=frozenset(a for a in mesh.axis_names
+                       if a != PIPE_AXIS and a not in batch_axes
+                       and mesh.shape[a] > 1),
         check_vma=False)
     def region(sp, edge, x, consts):
         sp = jax.tree.map(lambda a: a[0], sp)  # [1, ...] -> local stage slice
+        consts = jax.tree.map(lambda a, n: a[0] if n == 0 else a,
+                              consts, consts_ndims)
         x = jax.tree.map(lambda a, d: a.astype(d), x, x_dtypes)
         edge = jax.tree.map(lambda a, d: a.astype(d), edge, edge_dtypes)
         consts = jax.tree.map(jax.lax.stop_gradient, consts)
@@ -231,6 +306,8 @@ def gpipe_spmd(mesh,
             # Stack per-stage output buffers over 'pipe': the caller
             # slices the last stage's (the only meaningful one).
             aux_tot = jax.lax.psum(aux_acc, PIPE_AXIS)  # sum stages
+            if batch_axes:  # per-shard group-local aux -> batch mean
+                aux_tot = jax.lax.pmean(aux_tot, batch_axes)
             return outputs[None], aux_tot[None]
 
         # reduce mode: accumulate last_fn contributions, no [M] buffer
@@ -256,12 +333,18 @@ def gpipe_spmd(mesh,
 
         (_, acc, aux_acc), _ = jax.lax.scan(
             tick, (act0, acc0, jnp.zeros((), jnp.float32)), jnp.arange(T))
-        # only the last stage accumulated; psum broadcasts it to all
-        acc = jax.tree.map(lambda a: jax.lax.psum(a, PIPE_AXIS), acc)
+        # only the last stage accumulated; psum broadcasts it to all —
+        # and with manual batch axes, the per-shard (loss sum, count)
+        # accumulators sum into the GLOBAL totals (exact: the caller's
+        # loss_sum / count is then the global token-weighted mean)
+        acc = jax.tree.map(
+            lambda a: jax.lax.psum(a, (PIPE_AXIS,) + batch_axes), acc)
         aux_tot = jax.lax.psum(aux_acc, PIPE_AXIS)
+        if batch_axes:
+            aux_tot = jax.lax.pmean(aux_tot, batch_axes)
         return jax.tree.map(lambda a: a[None], acc), aux_tot[None]
 
-    res, aux = region(stage_params, edge_in, x_in, consts)
+    res, aux = region(stage_params, edge_in, x_in, consts_in)
     if last_fn is None:
         out = res[-1]
     else:
@@ -470,6 +553,10 @@ class PipelinedCausalLM:
                 self.mesh, self.num_stages, stage_fn, params["layers"], ids,
                 consts=(sin, cos, mask, abias_c, ids, labels_all, am_c,
                         positions),
+                consts_batched=(True, True, True, abias_all is not None,
+                                True,
+                                None if labels_all is None else True,
+                                None if am_c is None else True, True),
                 remat=cfg.remat,
                 first_fn=embed_mb, last_fn=head_and_ce, edge_params=edge,
                 stage_aux=moe_cfg is not None)
@@ -492,6 +579,8 @@ class PipelinedCausalLM:
                              consts=(sin, cos, mask,
                                      abias_all if abias_all is not None
                                      else jnp.zeros((M, 1), jnp.float32)),
+                             consts_batched=(True, True, True,
+                                             abias_all is not None),
                              remat=cfg.remat,
                              stage_aux=moe_cfg is not None)   # [M,b,s,e]
         aux_mean = jnp.zeros((), jnp.float32)
@@ -598,7 +687,8 @@ class PipelinedModule:
                 return loss_fn(out, y_mb)
 
             total = gpipe_spmd(self.mesh, self.num_stages, stage_fn,
-                               params, x, consts=(y,), last_fn=last_fn)
+                               params, x, consts=(y,), last_fn=last_fn,
+                               consts_batched=(True,))
             # Micro-batch average, matching the reference pipeline
             # engine (its total_loss accumulates per-micro-batch losses
             # and divides by micro_batches).  CONTRACT: loss_fn must

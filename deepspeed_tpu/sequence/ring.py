@@ -20,6 +20,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.jax_compat import axis_size as _axis_size
 import numpy as np
 from jax import lax
 
@@ -45,7 +47,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     training of windowed models; requires ``causal``."""
     if window is not None and not causal:
         raise ValueError("sliding window requires causal ring attention")
-    p = lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     r = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(d)
@@ -99,7 +101,7 @@ def ring_attention_sharded(q, k, v, mesh, causal: bool = True,
                            window: Optional[int] = None):
     """Convenience wrapper: q,k,v [B,H,S,D] globally, seq-sharded on 'seq'."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..utils.jax_compat import shard_map
     spec = P(None, None, "seq", None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name="seq", causal=causal,

@@ -35,6 +35,9 @@ class CommsLogger:
         self.debug = debug
         # op_name -> msg_size -> [count]
         self.comms_dict: Dict[str, Dict[int, List[int]]] = defaultdict(lambda: defaultdict(lambda: [0]))
+        # CollectiveScheduler static bucket plan (exact wire accounting:
+        # bytes on the wire, fp32-equivalent bytes, per-bucket volumes)
+        self.bucket_plan: Dict[str, Any] = {}
 
     def append_traced(self, op_name: str, tensor: Any) -> None:
         size = get_msg_size(tensor)
@@ -43,12 +46,45 @@ class CommsLogger:
             from .logging import logger
             logger.info("comm op: %s | msg size: %s", op_name, convert_size(size))
 
+    def record_bucket_plan(self, stats: Dict[str, Any]) -> None:
+        """Record the CollectiveScheduler's static wire plan (see
+        ``CollectiveScheduler.stats``) so log_summary can attribute
+        gradient-collective volume per bucket."""
+        self.bucket_plan = dict(stats)
+        if self.verbose:
+            from .logging import logger
+            logger.info(
+                "comm plan: %d bucket(s), %s/step on the wire "
+                "(fp32 equivalent %s), quantized fraction %.2f",
+                stats.get("bucket_count", 0),
+                convert_size(stats.get("comm_bytes_per_step", 0)),
+                convert_size(stats.get("comm_fp32_equiv_bytes_per_step", 0)),
+                stats.get("comm_quantized_fraction", 0.0))
+
     def log_summary(self) -> str:
         lines = [f"{'Comm. Op':<25}{'Message Size':<20}{'Count':<10}{'Total Volume':<15}"]
         for op, sizes in sorted(self.comms_dict.items()):
             for size, (count,) in sorted(sizes.items()):
                 lines.append(
                     f"{op:<25}{convert_size(size):<20}{count:<10}{convert_size(size * count):<15}")
+        if self.bucket_plan:
+            p = self.bucket_plan
+            lines.append("")
+            lines.append(
+                f"Gradient collective schedule: {p.get('bucket_count', 0)} "
+                f"bucket(s) over {p.get('reduce_axes')} "
+                f"(world {p.get('reduce_world')}), "
+                f"{convert_size(p.get('comm_bytes_per_step', 0))}/step "
+                f"wire vs {convert_size(p.get('comm_fp32_equiv_bytes_per_step', 0))} fp32-equiv, "
+                f"quantized fraction {p.get('comm_quantized_fraction', 0.0)}")
+            lines.append(f"{'Bucket':<10}{'Elems':<15}{'Wire Bytes':<15}"
+                         f"{'FP32 Bytes':<15}{'Quantized':<10}")
+            for b in p.get("per_bucket", []):
+                lines.append(
+                    f"{b['index']:<10}{b['elems']:<15}"
+                    f"{convert_size(b['wire_bytes']):<15}"
+                    f"{convert_size(b['fp32_bytes']):<15}"
+                    f"{str(b['quantized']):<10}")
         out = "\n".join(lines)
         from .logging import logger
         logger.info("Communication summary:\n%s", out)
@@ -56,3 +92,4 @@ class CommsLogger:
 
     def reset(self) -> None:
         self.comms_dict.clear()
+        self.bucket_plan = {}

@@ -28,6 +28,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("XLA_FLAGS", None)  # one device per process
 import jax
 jax.config.update("jax_platforms", "cpu")
+# cross-process collectives on the CPU backend need the Gloo transport
+# (without it: "Multiprocess computations aren't implemented on the CPU
+# backend"); newer JAX selects it automatically
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 sys.path.insert(0, {repo!r})
 RANK = int(os.environ["RANK"])
 WORLD = int(os.environ["WORLD_SIZE"])

@@ -1,0 +1,380 @@
+"""CollectiveScheduler tests — bucketed, quantized, overlap-scheduled
+gradient collectives (runtime/comm/collective_scheduler.py).
+
+Covers the acceptance contract: int8-wire training converges to within
+tolerance of the fp32 ``psum`` baseline; wire bytes per step drop >=3x
+vs the fp32 equivalent (asserted via the comms_logging counters); the
+chunked bucket path bit-matches the unbucketed path when quantization
+is off; and with the feature disabled the engine takes the exact
+compiler-psum path (scheduler absent)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as dst
+from deepspeed_tpu.models.base import SimpleModel
+
+
+def _cfg(comm=None, mesh=None, stage=2, gas=2, extra=None):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "tpu": {"mesh": mesh or {"data": 2, "fsdp": 4}},
+        "checkpoint": {"async_save": False},
+        "steps_per_print": 1000,
+    }
+    if comm is not None:
+        cfg["comm_optimization"] = comm
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def _batch(bs, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.normal(size=(bs, d)).astype(np.float32),
+            "y": rng.normal(size=(bs, d)).astype(np.float32)}
+
+
+def _train(config, batch, steps):
+    engine, *_ = dst.initialize(model=SimpleModel(64), config=config)
+    return engine, [float(engine.train_batch(batch)) for _ in range(steps)]
+
+
+class TestQuantizedWire:
+    def test_converges_close_to_fp32_psum_baseline(self):
+        """int8 wire + error feedback tracks the exact-psum trajectory
+        over N steps within tolerance, and actually learns."""
+        batch = _batch(64)
+        _, ref = _train(_cfg(), batch, 8)
+        engine, got = _train(_cfg({"enabled": True}), batch, 8)
+        assert engine.comm_scheduler is not None
+        assert np.isfinite(got).all()
+        np.testing.assert_allclose(got, ref, rtol=0.05)
+        assert got[-1] < got[0], "no learning through the int8 wire"
+        assert got != ref, "wire compression appears to be a no-op"
+
+    def test_wire_bytes_drop_at_least_3x(self):
+        """Acceptance: quantized wire bytes per step <= 1/3 of the fp32
+        equivalent, from the comms_logging counters."""
+        engine, _ = _train(_cfg({"enabled": True}), _batch(64), 1)
+        stats = engine.comm_stats()
+        assert stats["comm_quantized_fraction"] == 1.0
+        assert stats["comm_bytes_per_step"] * 3 <= \
+            stats["comm_fp32_equiv_bytes_per_step"]
+
+    def test_hlo_moves_int8_collectives(self):
+        """The compiled step must move s8 all-to-all payloads and no
+        gradient-sized fp32 collectives (the wire claim, in HLO)."""
+        import re
+        engine, _ = _train(_cfg({"enabled": True}), _batch(64), 0)
+        batch = _batch(64)
+        gas = engine.gradient_accumulation_steps()
+        bs = engine.train_batch_size()
+        shaped = {k: v.reshape((gas, bs // gas) + v.shape[1:])
+                  for k, v in batch.items()}
+        with engine.topology.mesh:
+            placed = engine._place_batch(shaped, microbatched=True)
+            txt = engine._train_step.lower(
+                engine.state, placed, engine._next_rng()).compile().as_text()
+        assert re.search(r"all-to-all[^\n]*s8\[", txt), \
+            "no int8 all-to-all in compiled HLO"
+        f32_coll = 0
+        for line in txt.splitlines():
+            if ("all-to-all" in line or "reduce-scatter" in line
+                    or "all-reduce" in line):
+                for dt, dims in re.findall(r"(f32)\[([\d,]+)\]", line):
+                    f32_coll += 4 * int(np.prod(
+                        [int(d) for d in dims.split(",") if d]))
+        n_params = sum(x.size for x in jax.tree.leaves(engine.state.params))
+        assert f32_coll < 4 * n_params, (
+            f"fp32 collective bytes {f32_coll} >= uncompressed gradient "
+            f"wire {4 * n_params} — compression not on the wire")
+
+    def test_fp16_overflow_does_not_poison_residuals(self):
+        """An overflow step quantizes inf gradients (NaN payload); the
+        error-feedback update from that step must be DISCARDED or every
+        later bucket inherits NaN and training never recovers."""
+        cfg = _cfg({"enabled": True}, extra={"fp16": {"enabled": True}})
+        engine, *_ = dst.initialize(model=SimpleModel(64), config=cfg)
+        good = _batch(64)
+        engine.train_batch(good)
+        bad = {"x": good["x"].copy(), "y": good["y"]}
+        bad["x"][0, 0] = np.inf
+        engine.train_batch(bad)
+        assert not engine.was_step_applied()
+        assert np.isfinite(np.asarray(engine.state.comm_residuals)).all()
+        after = [float(engine.train_batch(good)) for _ in range(3)]
+        assert np.isfinite(after).all() and after[-1] < after[0]
+
+    def test_legacy_qgz_has_no_residual_state(self):
+        """zero_quantized_gradients keeps its seed memory footprint: no
+        persistent error-feedback buffer unless comm_optimization is
+        enabled explicitly."""
+        engine, _ = _train(
+            _cfg(extra={"zero_optimization": {
+                "stage": 2, "zero_quantized_gradients": True}}),
+            _batch(64), 1)
+        assert engine.comm_scheduler is not None
+        assert jax.tree.leaves(engine.state.comm_residuals) == []
+
+    def test_error_feedback_residuals_live_in_state(self):
+        engine, _ = _train(_cfg({"enabled": True}), _batch(64), 2)
+        res = engine.state.comm_residuals
+        assert res.shape == (engine.comm_scheduler.world,
+                             engine.comm_scheduler.padded_elems)
+        assert float(np.abs(np.asarray(res)).sum()) > 0, \
+            "error feedback residuals never updated"
+
+    def test_no_error_feedback_still_converges(self):
+        """EF off: no residual state, trajectory still within tolerance
+        (at this model scale per-step int8 error is tiny either way —
+        EF's value shows at scale; its math is unit-tested below)."""
+        batch = _batch(64)
+        _, ref = _train(_cfg(), batch, 8)
+        engine, got = _train(
+            _cfg({"enabled": True, "error_feedback": False}), batch, 8)
+        assert jax.tree.leaves(engine.state.comm_residuals) == []
+        np.testing.assert_allclose(got, ref, rtol=0.05)
+        assert got[-1] < got[0]
+
+
+class TestBucketing:
+    def test_chunked_bit_matches_unbucketed_when_quantize_off(self):
+        """Bucket size smaller than the largest tensor => the flat grad
+        vector chunks across several psum collectives; elementwise the
+        reduction is identical, so losses must bit-match the one-bucket
+        run."""
+        batch = _batch(64)
+        # SimpleModel(64): largest leaf 64*64*4 = 16KB; 8KB buckets chunk it
+        eng_small, small = _train(
+            _cfg({"enabled": True, "quantize": False,
+                  "allreduce_bucket_size": 8 * 1024}), batch, 4)
+        eng_big, big = _train(
+            _cfg({"enabled": True, "quantize": False,
+                  "allreduce_bucket_size": 1 << 30}), batch, 4)
+        assert len(eng_small.comm_scheduler.buckets) > 1
+        assert len(eng_big.comm_scheduler.buckets) == 1
+        assert small == big, "bucket chunking changed the math"
+
+    def test_bucket_plan_alignment_and_coverage(self):
+        engine, _ = _train(
+            _cfg({"enabled": True, "allreduce_bucket_size": 8 * 1024}),
+            _batch(64), 0)
+        sched = engine.comm_scheduler
+        align = sched.world * sched.block
+        prev_end = 0
+        for b in sched.buckets:
+            assert b.start == prev_end, "buckets must tile the flat vector"
+            assert b.start % align == 0 and b.end % align == 0
+            prev_end = b.end
+        assert prev_end == sched.padded_elems >= sched.total_elems
+
+    def test_overlap_off_matches_tolerance(self):
+        batch = _batch(64)
+        _, ref = _train(_cfg(), batch, 6)
+        _, got = _train(_cfg({"enabled": True, "overlap": False}), batch, 6)
+        np.testing.assert_allclose(got, ref, rtol=0.05)
+        # one reduction per step vs per micro-batch: fewer wire rounds
+        eng, _ = _train(_cfg({"enabled": True, "overlap": False}),
+                        batch, 0)
+        s = eng.comm_stats()
+        assert s["bucket_rounds_per_step"] == 1
+
+
+class TestDisabledAndGating:
+    def test_disabled_is_exact_compiler_path(self):
+        """Without comm_optimization the scheduler must not exist and the
+        trajectory must be bit-identical to an explicit enabled=False."""
+        batch = _batch(64)
+        e1, l1 = _train(_cfg(), batch, 3)
+        e2, l2 = _train(_cfg({"enabled": False}), batch, 3)
+        assert e1.comm_scheduler is None and e2.comm_scheduler is None
+        assert e1.comm_stats() is None
+        assert l1 == l2
+
+    def test_legacy_qgz_flag_routes_through_scheduler(self):
+        batch = _batch(64)
+        engine, losses = _train(
+            _cfg(extra={"zero_optimization": {
+                "stage": 2, "zero_quantized_gradients": True}}), batch, 3)
+        assert engine.comm_scheduler is not None
+        assert engine.comm_scheduler.quantize
+        assert np.isfinite(losses).all()
+
+    def test_expert_mesh_falls_back(self):
+        engine, _ = _train(
+            _cfg({"enabled": True}, mesh={"data": 2, "fsdp": 2,
+                                          "expert": 2}), _batch(64), 1)
+        assert engine.comm_scheduler is None  # compiler psum fallback
+
+    def test_single_batch_shard_falls_back(self):
+        engine, _ = _train(
+            _cfg({"enabled": True}, mesh={"tensor": 8}), _batch(64), 0)
+        assert engine.comm_scheduler is None
+
+
+class TestAutoAxesMeshes:
+    def test_tensor_mesh_trains_close_to_plain(self):
+        """tensor axis stays GSPMD (auto) while data/fsdp take the int8
+        wire — the partial-auto region contract."""
+        batch = _batch(32)
+        mesh = {"data": 2, "fsdp": 2, "tensor": 2}
+        _, ref = _train(_cfg(None, mesh=mesh), batch, 4)
+        engine, got = _train(_cfg({"enabled": True}, mesh=mesh), batch, 4)
+        assert engine.comm_scheduler is not None
+        assert engine.comm_scheduler.auto_axes == {"tensor"}
+        np.testing.assert_allclose(got, ref, rtol=0.05)
+
+    def test_tp_llama_direct_leaves_and_training(self):
+        """A real TP-annotated model: tensor-sharded grads take the
+        direct psum, the rest ride the quantized buckets."""
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        rng = np.random.default_rng(0)
+
+        def mk(comm):
+            model = LlamaForCausalLM("debug", num_heads=4, num_kv_heads=2,
+                                     max_seq_len=32)
+            cfg = {
+                "train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "tensor_parallel": {"enabled": True, "tp_size": 2},
+                # scanned layers miscompile in partial-auto regions on
+                # this XLA version; the engine gates on it — unroll
+                "tpu": {"mesh": {"data": 2, "fsdp": 2, "tensor": 2},
+                        "scan_layers": False},
+                "steps_per_print": 1000,
+            }
+            if comm:
+                cfg["comm_optimization"] = comm
+            e, *_ = dst.initialize(model=model, config=cfg)
+            b = {"input_ids": rng.integers(
+                0, model.cfg.vocab_size,
+                size=(e.train_batch_size(), 32)).astype(np.int32)}
+            return e, b
+
+        engine, batch = mk({"enabled": True})
+        sched = engine.comm_scheduler
+        assert sched is not None and len(sched.direct_idx) > 0
+        assert 0 < engine.comm_stats()["comm_quantized_fraction"] < 1
+        got = [float(engine.train_batch(batch)) for _ in range(2)]
+        ref_engine, _ = mk(None)
+        ref = [float(ref_engine.train_batch(batch)) for _ in range(2)]
+        assert np.isfinite(got).all()
+        np.testing.assert_allclose(got, ref, rtol=0.05)
+
+    def test_scan_layers_gated_on_auto_mesh(self):
+        from deepspeed_tpu.models.llama import LlamaForCausalLM
+        model = LlamaForCausalLM("debug", num_heads=4, num_kv_heads=2,
+                                 max_seq_len=32)
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "tpu": {"mesh": {"data": 2, "fsdp": 2, "tensor": 2},
+                    "scan_layers": True},
+            "comm_optimization": {"enabled": True},
+            "steps_per_print": 1000,
+        }
+        e, *_ = dst.initialize(model=model, config=cfg)
+        assert e.comm_scheduler is None
+
+
+class TestObservability:
+    def test_stats_shape(self):
+        engine, _ = _train(
+            _cfg({"enabled": True, "allreduce_bucket_size": 8 * 1024}),
+            _batch(64), 0)
+        s = engine.comm_stats()
+        assert s["bucket_count"] == len(s["per_bucket"]) > 1
+        assert s["comm_bytes_per_step"] > 0
+        assert s["reduce_axes"] == ["data", "fsdp"]
+        for b in s["per_bucket"]:
+            assert b["wire_bytes"] < b["fp32_bytes"]
+
+    def test_comms_logger_records_bucket_plan(self):
+        # through the ENGINE config path (comms_logger block), not a
+        # hand-built logger — covers the dist facade re-export too
+        from deepspeed_tpu import comm as dist
+        engine, _ = _train(
+            _cfg({"enabled": True},
+                 extra={"comms_logger": {"enabled": True}}), _batch(64), 0)
+        lg = dist.get_comms_logger()
+        assert lg is not None and lg.bucket_plan
+        out = lg.log_summary()
+        assert "Gradient collective schedule" in out
+        assert "Bucket" in out
+
+    def test_profile_buckets(self):
+        engine, _ = _train(
+            _cfg({"enabled": True, "allreduce_bucket_size": 8 * 1024}),
+            _batch(64), 0)
+        prof = engine.comm_scheduler.profile_buckets(iters=1)
+        assert len(prof) == len(engine.comm_scheduler.buckets)
+        assert all(p["mean_ms"] >= 0 for p in prof)
+
+
+class TestCheckpointing:
+    def test_residuals_roundtrip_and_absence_tolerated(self, tmp_path):
+        batch = _batch(64)
+        engine, _ = _train(_cfg({"enabled": True}), batch, 2)
+        engine.save_checkpoint(str(tmp_path), tag="t")
+        # same-config engine restores residuals exactly
+        e2, *_ = dst.initialize(model=SimpleModel(64),
+                                config=_cfg({"enabled": True}))
+        e2.load_checkpoint(str(tmp_path), tag="t")
+        np.testing.assert_array_equal(
+            np.asarray(engine.state.comm_residuals),
+            np.asarray(e2.state.comm_residuals))
+        # plain checkpoint (no residuals) loads into a scheduler engine:
+        # residuals restart from zero
+        plain, _ = _train(_cfg(), batch, 1)
+        plain.save_checkpoint(str(tmp_path), tag="plain")
+        e3, *_ = dst.initialize(model=SimpleModel(64),
+                                config=_cfg({"enabled": True}))
+        e3.load_checkpoint(str(tmp_path), tag="plain")
+        assert float(np.abs(np.asarray(e3.state.comm_residuals)).sum()) == 0
+        assert np.isfinite(e3.train_batch(batch))
+        # scheduler checkpoint loads into a plain engine
+        e4, *_ = dst.initialize(model=SimpleModel(64), config=_cfg())
+        e4.load_checkpoint(str(tmp_path), tag="t")
+        assert np.isfinite(e4.train_batch(batch))
+
+
+def test_quantized_allreduce_ef_numerics():
+    """Unit: combined-axes int8 allreduce sums across all ranks of both
+    axes and returns exactly the unshipped first-hop error."""
+    from deepspeed_tpu.ops.quantization import (quantized_allreduce_ef,
+                                                quantize_dequantize)
+    from deepspeed_tpu.utils.jax_compat import shard_map
+    from deepspeed_tpu.parallel.topology import MeshTopology, TopologyConfig
+
+    topo = MeshTopology(TopologyConfig(data=2, fsdp=4))
+    world = 8
+    L = world * 512 * 2
+    rng = np.random.default_rng(0)
+    xg = rng.normal(size=(world, L)).astype(np.float32)
+
+    def region(v):
+        out, err = quantized_allreduce_ef(v[0], ("data", "fsdp"), world)
+        return out[None], err[None]
+
+    out, err = jax.jit(shard_map(
+        region, mesh=topo.mesh,
+        in_specs=P(("data", "fsdp"), None),
+        out_specs=(P(("data", "fsdp"), None), P(("data", "fsdp"), None)),
+        check_vma=False))(jnp.asarray(xg))
+    ref = xg.sum(0)
+    out = np.asarray(out)
+    scale = np.abs(ref).max()
+    for r in range(world):
+        assert np.abs(out[r] - ref).max() / scale < 0.02
+    ref_err = xg[0] - np.asarray(quantize_dequantize(jnp.asarray(xg[0])))
+    np.testing.assert_allclose(np.asarray(err)[0], ref_err, atol=1e-6)

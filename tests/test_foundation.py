@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 from deepspeed_tpu.parallel.topology import MeshTopology, TopologyConfig, MESH_AXES
 from deepspeed_tpu import comm as dist

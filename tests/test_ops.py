@@ -6,7 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.ops.flash_attention import (_flash_attention, flash_attention,

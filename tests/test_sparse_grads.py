@@ -71,7 +71,7 @@ class TestEmbeddingLookupGrad:
         np.testing.assert_allclose(np.asarray(g)[1], [3., 3., 3., 3.])
 
     def test_sparse_allreduce_over_mesh(self):
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
         from deepspeed_tpu.parallel.topology import MeshTopology, TopologyConfig
         topo = MeshTopology(TopologyConfig(data=8))

@@ -100,7 +100,7 @@ def test_qwz_trains_and_quantizes():
 
 
 def test_quantized_all_gather_st_grad():
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
     from jax.sharding import Mesh
     from deepspeed_tpu.ops.quantization import quantized_all_gather_st
 
@@ -220,7 +220,7 @@ class TestQgzWire:
         (shard_dim=None) must still be summed over BOTH the fsdp and
         data axes — batch shards live on both.  Covers the small-leaf
         exact-psum path, the int8 path, and the sharded-but-tiny path."""
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
         from deepspeed_tpu.ops.quantization import \
             quantized_grad_reduce_shard
