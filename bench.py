@@ -127,10 +127,16 @@ def _init_backend():
 def bench_fastgen(jax):
     """FastGen leg: continuous batching through FastGenScheduler.
 
-    Random-init weights (throughput does not depend on values); a warmup
-    pass compiles the Q-bucket steps so TTFT measures scheduling + device
-    time, not XLA compiles (the reference benchmarks steady-state too).
-    Returns {} on failure so the training metric still reports.
+    Random-init weights (throughput does not depend on values); compile
+    cost is paid BEFORE the timed window (``engine.precompile`` with
+    BENCH_PRECOMPILE, else a full warmup run) and reported separately as
+    ``fastgen_compile_s``, so ``fastgen_ttft_p50_ms`` measures
+    steady-state TTFT, not first-use XLA compile spikes.  The serving
+    counters (programs per step, host<->device bytes) ride along so the
+    fused step's "one program, token-sized transfer" claim is measured;
+    BENCH_FASTGEN_COMPARE=1 (default) also times the split-path escape
+    hatch on the same engine.  Returns {} on failure so the training
+    metric still reports.
     """
     import numpy as np
     n_req = int(os.environ.get("BENCH_FASTGEN_REQS", "32"))
@@ -140,8 +146,10 @@ def bench_fastgen(jax):
         from deepspeed_tpu.inference.v2 import (FastGenScheduler,
                                                 InferenceEngineV2,
                                                 RaggedInferenceModel,
-                                                SamplingParams)
+                                                SamplingParams,
+                                                ServingOptimizationConfig)
         from deepspeed_tpu.models.llama import LlamaForCausalLM
+        from deepspeed_tpu.utils.comms_logging import serving_counters
         from flax.core import meta
 
         model = LlamaForCausalLM(model_size)
@@ -160,9 +168,12 @@ def bench_fastgen(jax):
         prompts = [rng.integers(0, model.cfg.vocab_size,
                                 size=int(l)).tolist() for l in lens]
         sp = SamplingParams(max_new_tokens=max_new, temperature=0.0)
+        split_serving = ServingOptimizationConfig(
+            fused_step=False, on_device_sampling=False,
+            async_scheduling=False)
 
-        def run(reqs):
-            sched = FastGenScheduler(eng)
+        def run(reqs, serving=None):
+            sched = FastGenScheduler(eng, serving=serving)
             submit_t = {}
             first_t = {}
             t0 = time.perf_counter()
@@ -190,13 +201,16 @@ def bench_fastgen(jax):
             ttfts = [first_t[i] - submit_t[i] for i in reqs if i in first_t]
             return total, ttfts, done_tokens
 
+        # compile OUTSIDE the timed window, reported separately
+        t_pre = time.perf_counter()
         if os.environ.get("BENCH_PRECOMPILE"):
             # full production lattice (every bucket the engine can ever
-            # form) — thorough but many compiles; the default warm run
-            # below compiles exactly the buckets the measured run hits
-            t_pre = time.perf_counter()
+            # form, incl. the fused sample/chain variants) — thorough
+            # but many compiles; the default warm run below compiles
+            # exactly the buckets the measured run hits
             keys = eng.precompile(max_prompt=max_prompt,
-                                  max_new_tokens=max_new, strict=True)
+                                  max_new_tokens=max_new, strict=True,
+                                  sampling=True)
             sys.stderr.write(
                 f"bench: precompiled {len(keys)} buckets in "
                 f"{time.perf_counter() - t_pre:.1f}s\n")
@@ -204,16 +218,39 @@ def bench_fastgen(jax):
         # to powers of two, so an identical run precompiles every bucket
         # shape the measured run will hit
         run(range(n_req))
+        compile_s = time.perf_counter() - t_pre
+
+        serving_counters.reset()
         total, ttfts, done_tokens = run(range(n_req))
+        counters = serving_counters.snapshot()
         ttfts.sort()
-        return {
+        result = {
             "fastgen_req_s": round(n_req / total, 2),
             "fastgen_ttft_p50_ms": round(
                 1e3 * ttfts[len(ttfts) // 2], 1) if ttfts else None,
             "fastgen_decode_tok_s": round(done_tokens / total, 1),
+            "fastgen_compile_s": round(compile_s, 1),
+            "fastgen_programs_per_step": counters["programs_per_step"],
+            "fastgen_h2d_bytes_per_step": counters["h2d_bytes_per_step"],
+            "fastgen_d2h_bytes_per_step": counters["d2h_bytes_per_step"],
+            "fastgen_logits_bytes_per_step":
+                counters["logits_exposed_bytes_per_step"],
             "fastgen_model": model_size,
             **({"fastgen_quant": quant} if quant else {}),
         }
+        if os.environ.get("BENCH_FASTGEN_COMPARE", "1") != "0":
+            # escape-hatch comparison on the SAME engine (per-Q-bucket
+            # programs + host sampling over [n, V] logits)
+            run(range(n_req), serving=split_serving)   # warm split buckets
+            serving_counters.reset()
+            s_total, _, s_done = run(range(n_req), serving=split_serving)
+            s_count = serving_counters.snapshot()
+            result["fastgen_split_decode_tok_s"] = round(s_done / s_total, 1)
+            result["fastgen_split_programs_per_step"] = \
+                s_count["programs_per_step"]
+            result["fastgen_split_logits_bytes_per_step"] = \
+                s_count["logits_exposed_bytes_per_step"]
+        return result
     except Exception as e:  # noqa: BLE001 — aux leg must not kill the bench
         sys.stderr.write(f"bench: fastgen leg failed: {e}\n")
         return {"fastgen_error": str(e)[:300]}

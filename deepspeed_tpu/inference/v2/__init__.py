@@ -1,5 +1,5 @@
 from .config import (KVCacheUserConfig, RaggedInferenceEngineConfig,
-                     StateManagerConfig)
+                     ServingOptimizationConfig, StateManagerConfig)
 from .engine import InferenceEngineV2, SchedulingError, SchedulingResult
 from .factory import build_hf_engine
 from .model import RaggedInferenceModel
@@ -7,15 +7,17 @@ from .model_implementations import (implementation_for,
                                     supported_model_types)
 from .ragged import (BlockedAllocator, BlockedKVCache, KVCacheConfig,
                      RaggedBatch, StateManager, build_batch)
-from .sampling import SamplingParams, sample
+from .sampling import SamplingParams, sample, sample_dynamic
 from .scheduler import FastGenScheduler, Request, generate
 
 __all__ = [
-    "KVCacheUserConfig", "RaggedInferenceEngineConfig", "StateManagerConfig",
+    "KVCacheUserConfig", "RaggedInferenceEngineConfig",
+    "ServingOptimizationConfig", "StateManagerConfig",
     "InferenceEngineV2", "SchedulingError", "SchedulingResult",
     "build_hf_engine",
     "RaggedInferenceModel", "implementation_for", "supported_model_types",
     "BlockedAllocator", "BlockedKVCache",
     "KVCacheConfig", "RaggedBatch", "StateManager", "build_batch",
-    "SamplingParams", "sample", "FastGenScheduler", "Request", "generate",
+    "SamplingParams", "sample", "sample_dynamic",
+    "FastGenScheduler", "Request", "generate",
 ]

@@ -36,6 +36,24 @@ class QuantizationConfig:
 
 
 @dataclasses.dataclass
+class ServingOptimizationConfig:
+    """Fused serving-step knobs (ISSUE 2): one scheduler step = one
+    compiled device program + one token-sized host transfer.  Each flag
+    is an independent escape hatch back to the seed behavior (per-Q-
+    bucket programs, host-side sampling over [n, V] logits, synchronous
+    stepping); ``{"enabled": False}`` in a config dict flips all three."""
+    #: one compiled program per mixed prefill+decode step (off: the
+    #: per-Q-bucket split with host-side logits re-assembly)
+    fused_step: bool = True
+    #: sample inside the compiled step; only int32 tokens cross d2h
+    on_device_sampling: bool = True
+    #: double-buffered scheduler: step k+1 dispatches (device-chained
+    #: token gather) while step k's tokens are in flight — token values
+    #: reach the host one step late
+    async_scheduling: bool = True
+
+
+@dataclasses.dataclass
 class RaggedInferenceEngineConfig:
     state_manager: StateManagerConfig = dataclasses.field(
         default_factory=StateManagerConfig)
@@ -43,6 +61,8 @@ class RaggedInferenceEngineConfig:
         default_factory=KVCacheUserConfig)
     quantization: QuantizationConfig = dataclasses.field(
         default_factory=QuantizationConfig)
+    serving: ServingOptimizationConfig = dataclasses.field(
+        default_factory=ServingOptimizationConfig)
     tp_size: int = 1
 
     @classmethod
@@ -59,5 +79,15 @@ class RaggedInferenceEngineConfig:
         for k, v in d.get("quantization", {}).items():
             if hasattr(cfg.quantization, k):
                 setattr(cfg.quantization, k, v)
+        srv = d.get("serving_optimization", {})
+        if not srv.get("enabled", True):
+            # the master escape hatch wins over individual flags
+            cfg.serving = ServingOptimizationConfig(
+                fused_step=False, on_device_sampling=False,
+                async_scheduling=False)
+        else:
+            for k, v in srv.items():
+                if hasattr(cfg.serving, k):
+                    setattr(cfg.serving, k, v)
         cfg.tp_size = d.get("tensor_parallel", {}).get("tp_size", 1)
         return cfg

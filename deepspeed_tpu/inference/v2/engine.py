@@ -5,12 +5,15 @@ Reference contract: ``inference/v2/engine_v2.py:30`` —
 logits per sequence; ``query``/``can_schedule`` expose KV/token
 occupancy to the scheduler; ``flush(uid)`` frees sequence state.
 
-TPU deltas: the forward is internally *grouped by Q-bucket* — a mixed
-put() of prefill chunks and decode tokens runs one compiled program per
-bucket (decode Q=1 compiles once and is allocation-free via KV
-donation), rather than one CUDA megakernel over a flat token array.
-Logits rows are re-assembled in uid order, so callers see the reference
-semantics exactly.
+TPU deltas: by default (``serving.fused_step``) a mixed put() of prefill
+chunks and decode tokens lowers into ONE compiled program over a unified
+ragged layout — the superbucket the ragged Pallas kernel serves in a
+single launch — with logits rows already in uid order.  The escape hatch
+(``fused_step=False``) restores the seed behavior: one compiled program
+per Q-bucket with host-side logits re-assembly.  On top of the logits
+contract, ``step_sample``/``step_decode_chained`` run forward + sampling
+as one program so only int32 tokens ever cross device->host (the
+FastGenScheduler's double-buffered hot path).
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ...utils.comms_logging import serving_counters
 from .config import RaggedInferenceEngineConfig
 from .model import RaggedInferenceModel
 from .ragged import (KVCacheConfig, StateManager, build_batch,
@@ -83,7 +87,8 @@ class InferenceEngineV2:
 
     def precompile(self, max_prompt: int, max_concurrency: int = 0,
                    max_new_tokens: int = 256,
-                   strict: bool = False) -> List[Tuple[int, int, int]]:
+                   strict: bool = False,
+                   sampling: bool = False) -> List[Tuple]:
         """AOT-compile the (S, Q, P) bucket lattice this engine can hit
         (verdict on live serving: a first-use XLA compile is a TTFT
         spike; the reference captures CUDA graphs at engine build).
@@ -95,18 +100,17 @@ class InferenceEngineV2:
         Buckets whose S*Q exceeds max_ragged_batch_size are skipped (the
         scheduler can never form them).  With ``strict``, any later
         cache-miss bucket raises instead of compiling on the request
-        path.  Returns the compiled keys."""
-        import inspect
-
-        from .ragged.batch import _bucket, build_batch
+        path.  ``sampling`` additionally lowers each superbucket's fused
+        sample variants (greedy + stochastic) and, for decode buckets,
+        the chained double-buffer step — the FastGenScheduler's hot path
+        when serving_optimization is on.  Returns the compiled keys."""
+        from .ragged.batch import MIN_PAGES, MIN_SLOTS, _bucket
         sm = self._config.state_manager
         max_concurrency = max_concurrency or sm.max_ragged_sequence_count
         page = self._model.kv_config.page_size
-        # floors MUST mirror build_batch's defaults or the lattice misses
-        # the buckets the live path actually forms
-        bb = inspect.signature(build_batch).parameters
-        min_slots = bb["min_slots"].default
-        min_pages = bb["min_pages"].default
+        # floors shared with build_batch via the exported module
+        # constants — the lattice can't drift from the live path
+        min_slots, min_pages = MIN_SLOTS, MIN_PAGES
 
         s_vals, q_vals, p_vals = [], [1], []
         s = _bucket(1, min_slots)
@@ -145,6 +149,24 @@ class InferenceEngineV2:
                         key = (S, Q, P, fresh)
                         self._model.precompile_step(key, kv)
                         keys.append(key)
+                        if not sampling:
+                            continue
+                        for greedy in (True, False):
+                            skey = key + ("sample", greedy)
+                            self._model.precompile_step(skey, kv)
+                            keys.append(skey)
+                            if Q == 1 and not fresh:
+                                # double-buffer chain: the previous
+                                # step's slot bucket can only be >= this
+                                # one's (chained rows are a subset of
+                                # the previous step's rows)
+                                for prev_s in s_vals:
+                                    if prev_s < S:
+                                        continue
+                                    ckey = (S, 1, P, False, "chain",
+                                            prev_s, greedy)
+                                    self._model.precompile_step(ckey, kv)
+                                    keys.append(ckey)
         if strict:
             self._model.strict_shapes = True
         return keys
@@ -224,26 +246,80 @@ class InferenceEngineV2:
         return SchedulingResult.Success
 
     # -- the forward ---------------------------------------------------------
-    def put(self, batch_uids: Sequence[int],
-            batch_tokens: Sequence[np.ndarray],
-            do_checks: bool = True) -> jax.Array:
-        """One ragged forward; returns logits [len(batch_uids), V] in
-        input order."""
+    def _admit_batch(self, batch_uids, batch_tokens, do_checks):
+        """Shared put/step preamble: schedulability check + KV
+        reservation + in-flight marking.  Returns the descriptors."""
         if do_checks:
             res = self.can_schedule(batch_uids,
                                     [len(t) for t in batch_tokens])
             if res != SchedulingResult.Success:
                 raise SchedulingError(res)
-
         descs = []
         for uid, toks in zip(batch_uids, batch_tokens):
             sd = self._state.get_or_create_sequence(uid)
             self._state.allocate_for(sd, len(toks))
             sd.pre_forward(len(toks))
             descs.append(sd)
+        return descs
 
-        # group by Q bucket: decode (len==1) and prefill groups compile
-        # separately so decodes never pad to prefill width.
+    def _commit_batch(self, descs) -> None:
+        """Shared put/step epilogue: commit host bookkeeping (the token
+        VALUES may still be in flight on device — only counts matter
+        here) and run sliding-window page eviction."""
+        window = getattr(self._model.cfg, "sliding_window", None)
+        for sd in descs:
+            sd.post_forward()
+            if window:
+                # Mistral serving: pages wholly outside the window are
+                # unreachable for every future query — return them to the
+                # pool so live KV is O(window), not O(context)
+                self._state.evict_window(sd, window)
+
+    def _build_batch(self, descs, tokens, h2d_tokens: bool = True):
+        """Pack one segment; h2d bytes accrue here, program dispatches
+        are recorded by the caller (a mixed step feeds TWO segments to
+        ONE program).  ``h2d_tokens=False`` for chained steps, whose
+        token ids never leave the device (the placeholder token_ids
+        array is not an input of the chained program)."""
+        batch = build_batch(
+            descs, tokens, self._model.kv_config.page_size,
+            fresh_supported=getattr(self._model, "_fresh_attention",
+                                    None) is not None)
+        nbytes = (batch.q_lens.nbytes + batch.start_pos.nbytes
+                  + batch.page_table.nbytes)
+        if h2d_tokens:
+            nbytes += batch.token_ids.nbytes
+        serving_counters.record_h2d(nbytes)
+        return batch
+
+    def put(self, batch_uids: Sequence[int],
+            batch_tokens: Sequence[np.ndarray],
+            do_checks: bool = True,
+            fused: Optional[bool] = None) -> jax.Array:
+        """One ragged forward; returns logits [len(batch_uids), V] in
+        input order.  ``fused`` None follows the engine's
+        serving_optimization config; True forces the single-program
+        superbucket, False the seed per-Q-bucket split."""
+        if fused is None:
+            fused = self._config.serving.fused_step
+        descs = self._admit_batch(batch_uids, batch_tokens, do_checks)
+
+        if fused:
+            # ONE program over the unified ragged layout: decode rows
+            # (Q=1) and prefill chunks share a [S, Qmax] superbucket;
+            # slot order == input order, so no host re-assembly
+            batch = self._build_batch(
+                descs, [np.asarray(t) for t in batch_tokens])
+            serving_counters.record_program()
+            logits, self._state.kv_cache.data = self._model.forward(
+                batch, self._state.kv_cache.data)
+            logits = logits[:len(batch_uids)]
+            self._commit_batch(descs)
+            serving_counters.record_logits_exposed(int(logits.size) * 4)
+            return logits
+
+        # escape hatch: group by Q bucket — decode (len==1) and prefill
+        # groups compile separately so decodes never pad to prefill width
         groups: Dict[int, List[int]] = {}
         for i, toks in enumerate(batch_tokens):
             q = 1
@@ -256,25 +332,150 @@ class InferenceEngineV2:
             idxs = groups[q_bucket]
             sub_descs = [descs[i] for i in idxs]
             sub_tokens = [np.asarray(batch_tokens[i]) for i in idxs]
-            batch = build_batch(
-                sub_descs, sub_tokens, self._model.kv_config.page_size,
-                fresh_supported=getattr(self._model, "_fresh_attention",
-                                        None) is not None)
+            batch = self._build_batch(sub_descs, sub_tokens)
+            serving_counters.record_program()
             logits, self._state.kv_cache.data = self._model.forward(
                 batch, self._state.kv_cache.data)
             for row, i in enumerate(idxs):
                 logits_rows[i] = logits[row]
 
-        window = getattr(self._model.cfg, "sliding_window", None)
-        for sd in descs:
-            sd.post_forward()
-            if window:
-                # Mistral serving: pages wholly outside the window are
-                # unreachable for every future query — return them to the
-                # pool so live KV is O(window), not O(context)
-                self._state.evict_window(sd, window)
+        self._commit_batch(descs)
         import jax.numpy as jnp
-        return jnp.stack(logits_rows)
+        out = jnp.stack(logits_rows)
+        serving_counters.record_logits_exposed(int(out.size) * 4)
+        return out
+
+    def predict_step_key(self, batch_uids: Sequence[int],
+                         batch_tokens: Sequence, suffix: tuple = ()
+                         ) -> tuple:
+        """The step-cache key a single-geometry dispatch of this batch
+        will form, BEFORE admission — the strict-shapes scheduler gates
+        fused dispatch on lattice membership of this prediction.  Must
+        mirror ``build_batch``'s bucketing exactly (which is why it
+        lives here, next to the live path, not in the scheduler).
+        ``suffix`` extends the (S, Q, P, fresh) base: ``("sample",
+        greedy)`` or ``("chain", prev_len, greedy)``."""
+        from .ragged.batch import MIN_PAGES, MIN_SLOTS, _bucket
+        model = self._model
+        page = model.kv_config.page_size
+        pages, all_new = [], True
+        for uid, toks in zip(batch_uids, batch_tokens):
+            sd = self._state.get_sequence(uid)
+            seen = sd.seen_tokens if sd is not None else 0
+            cap = sd.allocated_capacity if sd is not None else 0
+            pages.append(max(cap, -(-(seen + len(toks)) // page)))
+            if seen:
+                all_new = False
+        S = _bucket(len(batch_uids), MIN_SLOTS)
+        Q = _bucket(max(len(t) for t in batch_tokens))
+        fresh = (all_new and Q > 1
+                 and getattr(model, "_fresh_attention", None) is not None)
+        return (S, Q, _bucket(max(pages), MIN_PAGES), fresh) + suffix
+
+    # -- fused forward+sampling steps (serving_optimization hot path) -------
+    def _pad_sample_params(self, row_params, S):
+        """Per-row sampling params padded to the slot bucket.  Padding
+        rows are greedy (argmax over garbage logits nobody reads)."""
+        temps = np.zeros(S, np.float32)
+        top_ks = np.zeros(S, np.int32)
+        top_ps = np.ones(S, np.float32)
+        for i, p in enumerate(row_params):
+            temps[i] = p.temperature
+            top_ks[i] = p.top_k
+            top_ps[i] = p.top_p
+        return temps, top_ks, top_ps
+
+    def step_sample(self, batch_uids: Sequence[int],
+                    batch_tokens: Sequence[np.ndarray],
+                    row_params: Sequence, rng: jax.Array,
+                    do_checks: bool = True
+                    ) -> Tuple[jax.Array, List[int]]:
+        """One compiled program for a mixed SplitFuse step: fused
+        forward + on-device sampling.  Returns (device token array
+        int32, row map: output row per input); the [*, V] logits never
+        leave the device, and the caller syncs the tokens whenever it
+        likes (JAX async dispatch makes this the double-buffer overlap
+        point).  A step mixing decode rows with prefill chunks runs as
+        ONE program over TWO segment geometries ([S_d, 1] + [S_p, Q]) so
+        decode rows never pad to the chunk width.  ``row_params`` is one
+        SamplingParams per row; rows mid-prefill sample garbage the
+        caller ignores."""
+        descs = self._admit_batch(batch_uids, batch_tokens, do_checks)
+        dec_idx = [i for i, t in enumerate(batch_tokens) if len(t) == 1]
+        pre_idx = [i for i, t in enumerate(batch_tokens) if len(t) > 1]
+
+        if not dec_idx or not pre_idx:       # single-geometry step
+            batch = self._build_batch(
+                descs, [np.asarray(t) for t in batch_tokens])
+            temps, top_ks, top_ps = self._pad_sample_params(
+                row_params, batch.num_slots)
+            greedy_only = not bool((temps > 0.0).any())
+            serving_counters.record_program(
+                h2d_bytes=temps.nbytes + top_ks.nbytes + top_ps.nbytes)
+            tokens, self._state.kv_cache.data = self._model.sample_step(
+                batch, self._state.kv_cache.data, rng, temps, top_ks,
+                top_ps, greedy_only)
+            self._commit_batch(descs)
+            return tokens, list(range(len(batch_uids)))
+
+        dec = self._build_batch([descs[i] for i in dec_idx],
+                                [np.asarray(batch_tokens[i])
+                                 for i in dec_idx])
+        pre = self._build_batch([descs[i] for i in pre_idx],
+                                [np.asarray(batch_tokens[i])
+                                 for i in pre_idx])
+        # tokens come back [S_d + S_p] in segment order
+        row_of_input = [0] * len(batch_uids)
+        ordered_params = [None] * (dec.num_slots + pre.num_slots)
+        for row, i in enumerate(dec_idx):
+            row_of_input[i] = row
+            ordered_params[row] = row_params[i]
+        for row, i in enumerate(pre_idx):
+            row_of_input[i] = dec.num_slots + row
+            ordered_params[dec.num_slots + row] = row_params[i]
+        from .sampling import SamplingParams as _SP
+        ordered_params = [p if p is not None else _SP()
+                          for p in ordered_params]
+        temps, top_ks, top_ps = self._pad_sample_params(
+            ordered_params, len(ordered_params))
+        greedy_only = not bool((temps > 0.0).any())
+        serving_counters.record_program(
+            h2d_bytes=temps.nbytes + top_ks.nbytes + top_ps.nbytes)
+        tokens, self._state.kv_cache.data = self._model.sample_step_mixed(
+            dec, pre, self._state.kv_cache.data, rng, temps, top_ks,
+            top_ps, greedy_only)
+        self._commit_batch(descs)
+        return tokens, row_of_input
+
+    def step_decode_chained(self, batch_uids: Sequence[int],
+                            prev_tokens: jax.Array,
+                            gather_idx: Sequence[int],
+                            row_params: Sequence,
+                            rng: jax.Array) -> jax.Array:
+        """Decode-continuation step whose input token ids are gathered ON
+        DEVICE from the previous step's sampled tokens (``prev_tokens``,
+        possibly still in flight): row i continues the sequence that sat
+        in ``gather_idx[i]`` of the previous step's output.  No host
+        sync anywhere on this path — the double-buffered scheduler
+        drains step k's tokens while step k+1 executes."""
+        placeholder_toks = [np.zeros(1, np.int32)] * len(batch_uids)
+        descs = self._admit_batch(batch_uids, placeholder_toks,
+                                  do_checks=False)
+        batch = self._build_batch(descs, placeholder_toks,
+                                  h2d_tokens=False)
+        temps, top_ks, top_ps = self._pad_sample_params(
+            row_params, batch.num_slots)
+        greedy_only = not bool((temps > 0.0).any())
+        gather = np.zeros(batch.num_slots, np.int32)
+        gather[:len(batch_uids)] = np.asarray(gather_idx, np.int32)
+        serving_counters.record_program(
+            h2d_bytes=temps.nbytes + top_ks.nbytes + top_ps.nbytes
+            + gather.nbytes)
+        tokens, self._state.kv_cache.data = self._model.chained_step(
+            batch, self._state.kv_cache.data, prev_tokens, gather, rng,
+            temps, top_ks, top_ps, greedy_only)
+        self._commit_batch(descs)
+        return tokens
 
     def flush(self, uid: int) -> None:
         self._state.flush_sequence(uid)

@@ -241,7 +241,72 @@ class RaggedInferenceModel:
                           batch.start_pos, batch.page_table)
         return logits, kv
 
-    def _get_step(self, key) -> Callable:
+    def sample_step(self, batch: RaggedBatch, kv: jax.Array,
+                    rng: jax.Array, temps, top_ks, top_ps,
+                    greedy_only: bool) -> Tuple[jax.Array, jax.Array]:
+        """One compiled program: forward + on-device sampling.  Returns
+        (tokens [S] int32, new kv) — only the token array ever needs to
+        cross device->host (ISSUE 2 tentpole b).  ``greedy_only`` is a
+        STATIC specialization: all-greedy steps compile to plain argmax
+        with the vocab sort/cumsum machinery dead-code-eliminated."""
+        key = self._normalize_key(batch.shape_key) + (
+            "sample", bool(greedy_only))
+        step = self._get_step(key)
+        return step(self.params, kv, batch.token_ids, batch.q_lens,
+                    batch.start_pos, batch.page_table, rng,
+                    jnp.asarray(temps, jnp.float32),
+                    jnp.asarray(top_ks, jnp.int32),
+                    jnp.asarray(top_ps, jnp.float32))
+
+    def sample_step_mixed(self, dec_batch: RaggedBatch,
+                          pre_batch: RaggedBatch, kv: jax.Array,
+                          rng: jax.Array, temps, top_ks, top_ps,
+                          greedy_only: bool
+                          ) -> Tuple[jax.Array, jax.Array]:
+        """Mixed SplitFuse step as ONE compiled program over TWO batch
+        geometries: a decode segment [S_d, 1] and a prefill segment
+        [S_p, Q], KV threaded through both.  This keeps the one-program
+        one-dispatch property WITHOUT padding decode rows to the prefill
+        chunk width (a [S, Qmax] superbucket would compute Qmax
+        positions per decode row — Qmax× wasted FLOPs on the serving
+        hot path).  Tokens come back as [S_d + S_p] in segment order;
+        the sampling-param arrays follow that order."""
+        dk = self._normalize_key(dec_batch.shape_key)
+        pk = self._normalize_key(pre_batch.shape_key)
+        assert dk[1] == 1, "segment A of a mixed step is decode-only"
+        key = dk + ("mixed",) + pk + (bool(greedy_only),)
+        step = self._get_step(key)
+        return step(self.params, kv,
+                    dec_batch.token_ids, dec_batch.q_lens,
+                    dec_batch.start_pos, dec_batch.page_table,
+                    pre_batch.token_ids, pre_batch.q_lens,
+                    pre_batch.start_pos, pre_batch.page_table, rng,
+                    jnp.asarray(temps, jnp.float32),
+                    jnp.asarray(top_ks, jnp.int32),
+                    jnp.asarray(top_ps, jnp.float32))
+
+    def chained_step(self, batch: RaggedBatch, kv: jax.Array,
+                     prev_tokens: jax.Array, gather_idx, rng: jax.Array,
+                     temps, top_ks, top_ps, greedy_only: bool
+                     ) -> Tuple[jax.Array, jax.Array]:
+        """Decode-continuation step whose token ids come from the
+        PREVIOUS step's on-device token output (``prev_tokens``) via a
+        host-known slot gather — the device-side half of the scheduler's
+        double buffering: step k+1 dispatches while step k's tokens are
+        still in flight, with no host sync in between."""
+        S, Q, P, _ = self._normalize_key(batch.shape_key)
+        assert Q == 1, "chained steps are decode-only"
+        key = (S, 1, P, False, "chain", int(prev_tokens.shape[0]),
+               bool(greedy_only))
+        step = self._get_step(key)
+        return step(self.params, kv, prev_tokens,
+                    jnp.asarray(gather_idx, jnp.int32), batch.q_lens,
+                    batch.start_pos, batch.page_table, rng,
+                    jnp.asarray(temps, jnp.float32),
+                    jnp.asarray(top_ks, jnp.int32),
+                    jnp.asarray(top_ps, jnp.float32))
+
+    def _normalize_key(self, key) -> Tuple[int, int, int, bool]:
         if getattr(self, "_fresh_attention", None) is None \
                 and len(key) > 3 and key[3]:
             # no fresh-prefill implementation (ALiBi): the flag is inert,
@@ -249,46 +314,89 @@ class RaggedInferenceModel:
             # precompiled lattice contains (direct-forward callers may
             # hand us a batch built without fresh_supported=False)
             key = key[:3] + (False,)
+        return key
+
+    def _get_step(self, key) -> Callable:
+        key = self._normalize_key(key[:4]) + tuple(key[4:])
         fn = self._step_cache.get(key)
         if fn is None:
             if getattr(self, "strict_shapes", False):
                 raise RuntimeError(
-                    f"batch bucket {key} (S, Q, P, fresh) was not "
-                    "precompiled — live serving would eat this XLA "
-                    "compile as a TTFT spike.  Widen "
-                    "InferenceEngineV2.precompile(...) or disable "
+                    f"batch bucket {key} (S, Q, P, fresh[, kind, ...]) "
+                    "was not precompiled — live serving would eat this "
+                    "XLA compile as a TTFT spike.  Widen "
+                    "InferenceEngineV2.precompile(...) (sampling=True "
+                    "covers the fused sample/chain variants) or disable "
                     "strict_shapes.")
-            fn = jax.jit(functools.partial(
-                self._step_impl, fresh=self._fresh_of(key)),
-                donate_argnums=(1,))
+            fn = jax.jit(self._impl_of(key), donate_argnums=(1,))
             self._step_cache[key] = fn
         return fn
 
     def _fresh_of(self, key) -> bool:
         return bool(key[3]) if len(key) > 3 else False
 
+    def _impl_of(self, key) -> Callable:
+        """The python callable a step-cache key compiles to."""
+        kind = key[4] if len(key) > 4 else "logits"
+        if kind == "logits":
+            return functools.partial(self._step_impl,
+                                     fresh=self._fresh_of(key))
+        if kind == "sample":
+            return functools.partial(self._sample_step_impl,
+                                     fresh=self._fresh_of(key),
+                                     greedy_only=key[5])
+        if kind == "chain":
+            return functools.partial(self._chained_step_impl,
+                                     greedy_only=key[6])
+        if kind == "mixed":
+            # key = (S_d, 1, P_d, False, "mixed",
+            #        S_p, Q, P_p, fresh_p, greedy_only)
+            return functools.partial(self._mixed_sample_step_impl,
+                                     fresh_p=key[8], greedy_only=key[9])
+        raise ValueError(f"unknown step kind in cache key {key}")
+
+    def _step_avals(self, key, kv_aval) -> list:
+        """Abstract argument list for AOT-lowering one cache key."""
+        S, Q, P = key[:3]
+        i32, f32 = jnp.int32, jnp.float32
+        sds = jax.ShapeDtypeStruct
+        batch_avals = [sds((S, Q), i32), sds((S,), i32), sds((S,), i32),
+                       sds((S, P), i32)]
+        kind = key[4] if len(key) > 4 else "logits"
+
+        def sample_avals(n):
+            return [jax.eval_shape(lambda: jax.random.key(0)),
+                    sds((n,), f32), sds((n,), i32), sds((n,), f32)]
+
+        if kind == "logits":
+            return [self.params, kv_aval] + batch_avals
+        if kind == "sample":
+            return [self.params, kv_aval] + batch_avals + sample_avals(S)
+        if kind == "mixed":
+            S_p, Q_p, P_p = key[5:8]
+            pre_avals = [sds((S_p, Q_p), i32), sds((S_p,), i32),
+                         sds((S_p,), i32), sds((S_p, P_p), i32)]
+            return ([self.params, kv_aval] + batch_avals + pre_avals
+                    + sample_avals(S + S_p))
+        # chain: prev_tokens [S_prev] + gather_idx [S] replace token_ids
+        prev_s = key[5]
+        return ([self.params, kv_aval, sds((prev_s,), i32), sds((S,), i32)]
+                + batch_avals[1:] + sample_avals(S))
+
     def precompile_step(self, key: Tuple[int, int, int],
                         kv_aval) -> None:
-        """AOT-compile one (S, Q, P) bucket (reference: FastGen's CUDA
-        graphs are captured at engine build; under XLA the analogue is
-        lower().compile() before serving so no bucket compiles on the
-        request path)."""
-        S, Q, P = key[:3]
+        """AOT-compile one (S, Q, P[, fresh[, kind, ...]]) bucket
+        (reference: FastGen's CUDA graphs are captured at engine build;
+        under XLA the analogue is lower().compile() before serving so no
+        bucket compiles on the request path)."""
         if key in self._step_cache:
             return
-        fn = jax.jit(functools.partial(
-            self._step_impl, fresh=self._fresh_of(key)),
-            donate_argnums=(1,))
-        i32 = jnp.int32
+        fn = jax.jit(self._impl_of(key), donate_argnums=(1,))
         # the COMPILED executable goes into the cache: later calls with
         # the bucket's exact shapes dispatch straight to it (jit's own
         # dispatch cache is not populated by AOT lowering)
         self._step_cache[key] = fn.lower(
-            self.params, kv_aval,
-            jax.ShapeDtypeStruct((S, Q), i32),
-            jax.ShapeDtypeStruct((S,), i32),
-            jax.ShapeDtypeStruct((S,), i32),
-            jax.ShapeDtypeStruct((S, P), i32)).compile()
+            *self._step_avals(key, kv_aval)).compile()
 
     def _step_impl(self, params, kv, token_ids, q_lens, start_pos,
                    page_table, fresh: bool = False):
@@ -327,6 +435,63 @@ class RaggedInferenceModel:
         if "lm_head_bias" in params:  # phi family ships an lm_head bias
             logits = logits + params["lm_head_bias"].astype(cfg.dtype)
         return logits.astype(jnp.float32), kv
+
+    def _sample_step_impl(self, params, kv, token_ids, q_lens, start_pos,
+                          page_table, rng, temps, top_ks, top_ps,
+                          fresh: bool = False, greedy_only: bool = False):
+        """Forward + on-device sampling in ONE traced program: the [S, V]
+        logits never leave the device — only int32 tokens do."""
+        logits, kv = self._step_impl(params, kv, token_ids, q_lens,
+                                     start_pos, page_table, fresh=fresh)
+        if greedy_only:
+            tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            from .sampling import sample_dynamic
+            tokens = sample_dynamic(logits, rng, temps, top_ks, top_ps)
+        return tokens, kv
+
+    def _chained_step_impl(self, params, kv, prev_tokens, gather_idx,
+                           q_lens, start_pos, page_table, rng, temps,
+                           top_ks, top_ps, greedy_only: bool = False):
+        """Decode step whose token ids are gathered on device from the
+        previous step's sampled tokens (slot mapping is host-known), so
+        consecutive decode steps chain with no host round-trip."""
+        token_ids = jnp.take(prev_tokens, gather_idx)[:, None]  # [S, 1]
+        return self._sample_step_impl(
+            params, kv, token_ids, q_lens, start_pos, page_table, rng,
+            temps, top_ks, top_ps, fresh=False, greedy_only=greedy_only)
+
+    def _mixed_sample_step_impl(self, params, kv, d_tok, d_ql, d_sp,
+                                d_pt, p_tok, p_ql, p_sp, p_pt, rng,
+                                temps, top_ks, top_ps,
+                                fresh_p: bool = False,
+                                greedy_only: bool = False):
+        """Two-segment fused step: decode [S_d, 1] then prefill [S_p, Q]
+        through the same layers with the KV cache threaded between them
+        (distinct sequences, so segment order is free), logits
+        concatenated, sampled once — one compiled program, no
+        cross-geometry padding."""
+        logits_d, kv = self._step_impl(params, kv, d_tok, d_ql, d_sp,
+                                       d_pt, fresh=False)
+        logits_p, kv = self._step_impl(params, kv, p_tok, p_ql, p_sp,
+                                       p_pt, fresh=fresh_p)
+        logits = jnp.concatenate([logits_d, logits_p], axis=0)
+        if greedy_only:
+            tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            from .sampling import sample_dynamic
+            tokens = sample_dynamic(logits, rng, temps, top_ks, top_ps)
+        # pad the token vector to the slot bucket: S_d + S_p is an
+        # arbitrary sum, and a later chained step keys on the EXACT
+        # prev-token length — bucketing here collapses the chain-key
+        # space back to power-of-two lengths (one compile, not one per
+        # segment-sum)
+        from .ragged.batch import MIN_SLOTS, _bucket
+        pad = _bucket(tokens.shape[0], MIN_SLOTS) - tokens.shape[0]
+        if pad:
+            tokens = jnp.concatenate(
+                [tokens, jnp.zeros((pad,), jnp.int32)])
+        return tokens, kv
 
     def _layer_body(self, x, lp, kv_layer, *, pos, sin, cos, q_lens,
                     start_pos, page_table, fresh: bool = False):

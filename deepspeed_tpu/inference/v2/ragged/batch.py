@@ -27,6 +27,15 @@ import numpy as np
 from .sequence import SequenceDescriptor
 
 
+#: bucket-lattice floors shared by ``build_batch`` and
+#: ``InferenceEngineV2.precompile`` — exported constants so the AOT
+#: lattice can never silently drift from the live batching path (the
+#: previous ``inspect.signature`` introspection broke if the defaults
+#: moved into a wrapper or got keyword-only shuffled)
+MIN_SLOTS = 1
+MIN_PAGES = 8
+
+
 def _bucket(n: int, floor: int = 1) -> int:
     b = floor
     while b < n:
@@ -67,8 +76,8 @@ class RaggedBatch:
 def build_batch(seqs: Sequence[SequenceDescriptor],
                 tokens: Sequence[np.ndarray],
                 page_size: int,
-                min_slots: int = 1,
-                min_pages: int = 8,
+                min_slots: int = MIN_SLOTS,
+                min_pages: int = MIN_PAGES,
                 fresh_supported: bool = True) -> RaggedBatch:
     """Pack (descriptor, new-token) pairs into a bucketed RaggedBatch.
 

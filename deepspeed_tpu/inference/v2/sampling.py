@@ -24,6 +24,46 @@ class SamplingParams:
     stop_token: Optional[int] = None
 
 
+def sample_dynamic(logits: jax.Array, rng: jax.Array,
+                   temperature: jax.Array, top_k: jax.Array,
+                   top_p: jax.Array) -> jax.Array:
+    """Per-row dynamic sampling: logits [S, V] + per-row params -> [S].
+
+    The on-device half of the fused serving step: temperature/top_k/top_p
+    are DYNAMIC [S] inputs, so one compiled program covers every
+    params mix in a ragged batch — no host-side grouping, no per-group
+    kernels, and only the int32 tokens cross device->host.  Semantics
+    match ``sample`` row-for-row: temperature <= 0 selects argmax
+    (top_k/top_p are no-ops at temp 0), top_k <= 0 disables the k filter,
+    top_p >= 1 disables the nucleus filter, and the nucleus cutoff is
+    computed over the top-k-filtered distribution like the grouped path.
+    """
+    S, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    is_greedy = temperature <= 0.0
+    l = logits / jnp.where(is_greedy, 1.0, temperature)[:, None]
+    # top-k: the kth-largest value per row is the keep threshold
+    sorted_l = jnp.sort(l, axis=-1)[:, ::-1]                # descending
+    k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, V), V).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_l, (k_eff - 1)[:, None], axis=-1)
+    l = jnp.where(l < kth, -jnp.inf, l)
+    # top-p over the filtered distribution: derived from the FIRST sort
+    # by masking positions past k_eff instead of re-sorting the vocab
+    # (the top-k filter only drops values strictly below the kth — in
+    # the measure-zero case of exact ties AT the kth value the nucleus
+    # mass excludes the duplicate tail, while the final keep-filter on
+    # ``l`` still keeps every tied entry)
+    col = jnp.arange(V, dtype=jnp.int32)[None, :]
+    sorted_f = jnp.where(col < k_eff[:, None], sorted_l, -jnp.inf)
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.minimum(jnp.sum(cum < top_p[:, None], axis=-1), V - 1)
+    cutoff = jnp.take_along_axis(sorted_f, cutoff_idx[:, None], axis=-1)
+    l = jnp.where((top_p < 1.0)[:, None] & (l < cutoff), -jnp.inf, l)
+    sampled = jax.random.categorical(rng, l, axis=-1).astype(jnp.int32)
+    return jnp.where(is_greedy, greedy, sampled)
+
+
 @functools.partial(jax.jit, static_argnames=("temperature", "top_k", "top_p"))
 def sample(logits: jax.Array, rng: jax.Array, *, temperature: float = 0.0,
            top_k: int = 0, top_p: float = 1.0) -> jax.Array:

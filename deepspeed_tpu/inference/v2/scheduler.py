@@ -11,16 +11,29 @@ per-step latency flat.
 Admission runs on incremental page/token/sequence counters (O(1) per
 candidate) rather than re-validating the whole batch through
 ``can_schedule`` for each addition.
+
+Serving-optimization paths (engine config ``serving``, ISSUE 2): with
+``fused_step + on_device_sampling`` a step dispatches ONE compiled
+program (forward + sampling) and only int32 tokens cross device->host;
+with ``async_scheduling`` on top, steady-state decode double-buffers —
+step k+1 is dispatched through a device-side token gather
+(``step_decode_chained``) while step k's tokens are still in flight, so
+token values reach the host one step late (``step()`` returns the
+PREVIOUS step's tokens).  Requests that hit a stop token are detected at
+drain time; the one optimistically-dispatched extra token is discarded
+and its KV write is harmless (the flushed pages return to the pool and
+every page position is write-before-read for its next owner).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from ...utils.comms_logging import serving_counters
 from .engine import InferenceEngineV2
 from .sampling import SamplingParams, sample
 
@@ -38,6 +51,14 @@ class Request:
     @property
     def prefill_remaining(self) -> int:
         return len(self.prompt) - self.prompt_sent
+
+
+@dataclasses.dataclass
+class _Inflight:
+    """A dispatched-but-undrained fused step: the device token array and
+    the (uid, output row, request) triples of its SAMPLED rows."""
+    tokens_dev: jax.Array
+    rows: List[Tuple[int, int, Request]]
 
 
 class _Admission:
@@ -68,21 +89,47 @@ class _Admission:
         return True
 
 
+def _group_key(p: SamplingParams) -> tuple:
+    """Sampling-kernel bucket key: at temperature 0 top_k/top_p are
+    no-ops, so every greedy request shares ONE bucket regardless of its
+    stochastic knobs (fewer compiled sample() shapes per step)."""
+    if p.temperature <= 0.0:
+        return (0.0, 0, 1.0)
+    return (p.temperature, p.top_k, p.top_p)
+
+
 class FastGenScheduler:
     """Drives an InferenceEngineV2 with the SplitFuse policy."""
 
     def __init__(self, engine: InferenceEngineV2,
                  token_budget: Optional[int] = None,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 serving=None):
         self._engine = engine
         self._budget = (token_budget or
                         engine._config.state_manager.max_ragged_batch_size)
+        sv = serving if serving is not None else engine._config.serving
+        self._serving = sv
+        self._fused_cfg = bool(sv.fused_step and sv.on_device_sampling)
+        self._async_cfg = bool(self._fused_cfg and sv.async_scheduling)
+        self._warned_strict_fallback = False
+        self._inflight: Optional[_Inflight] = None
         self._pending: List[Request] = []     # waiting for first prefill
         self._preempted: Dict[int, Request] = {}  # KV offloaded to host
         self._preempted_this_step = False
         self._running: Dict[int, Request] = {}
-        self._rng = rng if rng is not None else jax.random.key(0)
+        if rng is None:
+            rng = jax.random.key(0)
+        elif not jax.dtypes.issubdtype(rng.dtype, jax.dtypes.prng_key):
+            # legacy uint32[2] PRNGKey: normalize to a typed key — the
+            # AOT-precompiled fused executables are lowered for typed
+            # keys and would reject the legacy layout at dispatch
+            rng = jax.random.wrap_key_data(rng)
+        self._rng = rng
         self.last_step_scheduled = 0
+        #: one-way latch: a strict engine's sampling lattice, once seen,
+        #: stays seen (avoids rescanning the step cache every step)
+        self._fused_ready = False
 
     # -- request lifecycle ---------------------------------------------------
     def submit(self, uid: int, prompt: Sequence[int],
@@ -93,18 +140,165 @@ class FastGenScheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._pending or self._running or self._preempted)
+        return bool(self._pending or self._running or self._preempted
+                    or self._inflight is not None)
+
+    @property
+    def _fused(self) -> bool:
+        """Fused serving, gated on strict-shapes coherence: an engine
+        precompiled WITHOUT the fused sample/chain variants
+        (``precompile(strict=True)`` with the default ``sampling=False``)
+        keeps serving through the seed split path instead of raising a
+        strict-miss on the first step — strict mode means "serve only
+        precompiled programs", whichever paths those are."""
+        if not self._fused_cfg:
+            return False
+        model = self._engine.model
+        if not getattr(model, "strict_shapes", False):
+            return True
+        if self._fused_ready:
+            return True
+        if self._warned_strict_fallback:
+            return False    # negative latch: don't rescan the cache
+        if any(len(k) > 4 and k[4] == "sample" for k in model._step_cache):
+            self._fused_ready = True
+            return True
+        from ...utils.logging import logger
+        logger.warning(
+            "strict_shapes engine has no precompiled fused sampling "
+            "buckets — serving through the split path for the life of "
+            "this scheduler; precompile with sampling=True (before "
+            "constructing the scheduler) for the fused step")
+        self._warned_strict_fallback = True
+        return False
+
+    @property
+    def _async(self) -> bool:
+        return self._async_cfg and self._fused
+
+    # -- rng -----------------------------------------------------------------
+    def _next_key(self, greedy_only: bool) -> jax.Array:
+        """Greedy-only steps never consume RNG state (argmax needs no
+        randomness — splitting a key per step would make greedy decode
+        depend on how many steps ran before it)."""
+        if greedy_only:
+            return self._rng
+        self._rng, key = jax.random.split(self._rng)
+        return key
+
+    # -- drain: sync a dispatched step's tokens ------------------------------
+    def _drain(self, on_token) -> Dict[int, int]:
+        if self._inflight is None:
+            return {}
+        inf, self._inflight = self._inflight, None
+        toks = np.asarray(inf.tokens_dev)   # the ONLY d2h: [S] int32
+        serving_counters.record_d2h(toks.nbytes)
+        out: Dict[int, int] = {}
+        for uid, row, req in inf.rows:
+            if req.done:
+                # optimistically chained past a stop token — the extra
+                # sampled token is discarded (its KV write landed in
+                # pages the flush already returned to the pool)
+                continue
+            tok = int(toks[row])
+            req.generated.append(tok)
+            out[uid] = tok
+            if on_token is not None:
+                on_token(uid, tok)
+            stop = req.params.stop_token
+            if (len(req.generated) >= req.params.max_new_tokens
+                    or (stop is not None and tok == stop)):
+                req.done = True
+                self._engine.flush(uid)
+                self._running.pop(uid, None)
+        return out
+
+    # -- double buffer: chained decode dispatch ------------------------------
+    def _plan_chain(self) -> Optional[List[Tuple[int, int, Request]]]:
+        """Rows for a device-chained decode step, or None when this step
+        can't chain (admissions pending, mid-prefill rows, restored or
+        unknown membership, KV pressure) and must take the host path."""
+        if not self._async or self._inflight is None:
+            return None
+        if self._pending or self._preempted:
+            return None
+        slot = {uid: row for uid, row, _ in self._inflight.rows}
+        adm = _Admission(self._engine, self._budget)
+        rows = []
+        for uid, req in self._running.items():
+            if req.prefill_remaining > 0:
+                return None
+            if uid not in slot:
+                return None
+            if len(req.generated) + 1 >= req.params.max_new_tokens:
+                # the in-flight token is its last — finishes at drain
+                continue
+            if not adm.try_admit(uid, 1, is_new=False):
+                return None     # host path handles preemption
+            rows.append((uid, slot[uid], req))
+        if not rows:
+            return None
+        # strict mode serves only precompiled programs: chain only when
+        # the EXACT key (incl. the previous step's token-array length)
+        # was AOT-lowered; otherwise the host path's lattice-covered
+        # steps take over
+        one = np.zeros(1, np.int32)
+        if not self._strict_key_ok(
+                [u for u, _, _ in rows], [one] * len(rows),
+                ("chain", int(self._inflight.tokens_dev.shape[0]),
+                 all(req.params.temperature <= 0.0
+                     for _, _, req in rows))):
+            return None
+        return rows
+
+    def _dispatch_chain(self, rows) -> _Inflight:
+        uids = [u for u, _, _ in rows]
+        gather = [r for _, r, _ in rows]
+        params = [req.params for _, _, req in rows]
+        greedy_only = all(p.temperature <= 0.0 for p in params)
+        toks = self._engine.step_decode_chained(
+            uids, self._inflight.tokens_dev, gather, params,
+            self._next_key(greedy_only))
+        self.last_step_scheduled = len(uids)
+        return _Inflight(tokens_dev=toks,
+                         rows=[(u, i, req)
+                               for i, (u, _, req) in enumerate(rows)])
+
+    def _strict_key_ok(self, uids, tokens, suffix: tuple) -> bool:
+        """Under strict shapes, fused dispatch requires the predicted
+        step-cache key to be AOT-compiled.  Slot/Q bucketing can push
+        bucket(S) * bucket(Q) past max_ragged_batch_size even when the
+        actual token count fits the budget — exactly the superbuckets
+        the precompile lattice skips — so membership, not arithmetic, is
+        the gate.  ``suffix`` is () for a logits key or
+        ("sample", greedy_only)."""
+        model = self._engine.model
+        if not getattr(model, "strict_shapes", False):
+            return True
+        key = self._engine.predict_step_key(uids, tokens, suffix)
+        return key in model._step_cache
 
     # -- one engine step -----------------------------------------------------
     def step(self, on_token: Optional[Callable[[int, int], None]] = None
              ) -> Dict[int, int]:
         """Schedule one ragged batch; returns {uid: new_token} for every
-        sequence that produced a token this step."""
-        uids: List[int] = []
-        tokens: List[np.ndarray] = []
-        reqs: List[Request] = []
-
+        sequence whose token became host-visible this step (with
+        async_scheduling that is the PREVIOUS step's tokens — one-step
+        lag)."""
+        serving_counters.record_step()
         self._preempted_this_step = False
+
+        chain = self._plan_chain()
+        if chain is not None:
+            # dispatch k+1 FIRST, then drain k: the host sync below
+            # overlaps the device executing the new step
+            new_inflight = self._dispatch_chain(chain)
+            out = self._drain(on_token)
+            self._inflight = new_inflight
+            return out
+
+        out_prev = self._drain(on_token)
+
         # resume preempted sequences first when the pool has room again
         # (restore cost = their live page count, plus decode headroom)
         for uid in list(self._preempted):
@@ -118,6 +312,9 @@ class FastGenScheduler:
                 self._running[uid] = self._preempted.pop(uid)
 
         adm = _Admission(self._engine, self._budget)
+        uids: List[int] = []
+        tokens: List[np.ndarray] = []
+        reqs: List[Request] = []
 
         # 1. all running decodes (one token each)
         for uid, req in self._running.items():
@@ -172,28 +369,74 @@ class FastGenScheduler:
                     self._engine.offload_sequence(victim)
                     self._preempted[victim] = self._running.pop(victim)
                     self._preempted_this_step = True
-            return {}
+            return out_prev
 
-        logits = self._engine.put(uids, tokens, do_checks=False)
-        out: Dict[int, int] = {}
-
-        # sample — one kernel per distinct sampling-params group
         sampled_rows = [i for i, r in enumerate(reqs)
                         if r.prefill_remaining == 0]
+
+        # strict shapes serve only AOT-compiled programs.  Mixed
+        # two-segment keys aren't enumerated by the lattice at all, and
+        # even single-geometry superbuckets can fall outside it (slot/Q
+        # bucket rounding past max_ragged_batch_size) — gate the fused
+        # dispatch on predicted-key membership and drop to the seed
+        # split path otherwise.
+        strict = getattr(self._engine.model, "strict_shapes", False)
+        strict_mixed = (strict and any(len(t) == 1 for t in tokens)
+                        and any(len(t) > 1 for t in tokens))
+        greedy_only = all(
+            (reqs[i].params.temperature <= 0.0
+             if reqs[i].prefill_remaining == 0 else True)
+            for i in range(len(reqs)))
+        use_fused = self._fused and not strict_mixed
+        if use_fused and strict and not self._strict_key_ok(
+                uids, tokens, ("sample", greedy_only)):
+            use_fused = False
+
+        if use_fused:
+            # ONE program: fused mixed-batch forward + on-device
+            # sampling; only the [S] int32 tokens ever reach the host
+            # mid-prefill rows produce no token: pin them greedy so a
+            # stochastic param on an unsampled row can't flip the step
+            # into the stochastic specialization (or consume RNG);
+            # greedy_only above uses the same sampled-rows-only rule
+            row_params = [r.params if r.prefill_remaining == 0
+                          else SamplingParams() for r in reqs]
+            toks, rowmap = self._engine.step_sample(
+                uids, tokens, row_params, self._next_key(greedy_only),
+                do_checks=False)
+            self._inflight = _Inflight(
+                tokens_dev=toks,
+                rows=[(uids[i], rowmap[i], reqs[i])
+                      for i in sampled_rows])
+            if not self._async:
+                out_prev.update(self._drain(on_token))
+            return out_prev
+
+        # escape-hatch split path: host sampling over put() logits.  The
+        # forward's fusion follows the SCHEDULER's serving view, not the
+        # engine's (a serving= override must reach the seed per-Q-bucket
+        # programs, or the escape hatch measures the fused forward);
+        # under strict shapes the fused logits superbucket must also be
+        # lattice-covered or put() falls back to per-bucket programs
+        put_fused = self._serving.fused_step and not strict_mixed
+        if put_fused and strict:
+            put_fused = self._strict_key_ok(uids, tokens, ())
+        logits = self._engine.put(uids, tokens, do_checks=False,
+                                  fused=put_fused)
         groups: Dict[tuple, List[int]] = {}
         for i in sampled_rows:
-            p = reqs[i].params
-            groups.setdefault((p.temperature, p.top_k, p.top_p),
-                              []).append(i)
+            groups.setdefault(_group_key(reqs[i].params), []).append(i)
         new_tokens: Dict[int, int] = {}
         for (temp, top_k, top_p), idxs in groups.items():
-            self._rng, key = jax.random.split(self._rng)
+            key = self._next_key(greedy_only=temp <= 0.0)
             toks = np.asarray(sample(logits[np.asarray(idxs)], key,
                                      temperature=temp, top_k=top_k,
                                      top_p=top_p))
+            serving_counters.record_d2h(toks.nbytes)
             for i, t in zip(idxs, toks):
                 new_tokens[i] = int(t)
 
+        out = dict(out_prev)
         for i, tok in new_tokens.items():
             req = reqs[i]
             req.generated.append(tok)
@@ -215,8 +458,8 @@ class FastGenScheduler:
         all_reqs.update(self._preempted)
         stalls = 0
         while self.has_work:
-            self.step()
-            if self.last_step_scheduled == 0:
+            out = self.step()
+            if self.last_step_scheduled == 0 and not out:
                 if self._preempted_this_step:
                     continue  # preemption IS progress: pages were freed
                 stalls += 1

@@ -14,12 +14,15 @@ to thread blocks; on TPU the ragged batch is instead padded to a static
                         dense einsum -> MXU, raggedness lives in masks.
 * ``gather_last``     — last-token hidden-state gather for logits.
 
-``paged_decode_attention`` is the Pallas specialization of the decode
-path (Q=1): a ``(slot, kv_head, page)`` grid whose BlockSpec index map
-reads the page table via scalar prefetch, so each KV page is DMA'd
-HBM->VMEM exactly once and the gathered ``[S, C, K, D]`` context never
-materializes in HBM.  The jnp formulation is the semantics ground truth
-and the CPU/CI path; ``paged_attention`` auto-selects.
+``paged_decode_attention`` is the Pallas ragged kernel: a
+``(slot, kv_head, page)`` grid whose BlockSpec index map reads the page
+table via scalar prefetch, so each KV page is DMA'd HBM->VMEM exactly
+once and the gathered ``[S, C, K, D]`` context never materializes in
+HBM.  Q=1 is the classic decode step; Q>1 rows carry prefill chunks
+with per-row causal limits, so ONE launch serves a fused mixed
+prefill+decode ragged batch (Ragged Paged Attention, arxiv 2604.15464).
+The jnp formulation is the semantics ground truth and the CPU/CI path;
+``paged_attention`` auto-selects.
 """
 
 from __future__ import annotations
@@ -38,6 +41,11 @@ _CompilerParams = getattr(pltpu, "CompilerParams",
                           getattr(pltpu, "TPUCompilerParams", None))
 
 MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+#: largest Q * gqa_groups query block the ragged Pallas kernel accepts
+#: before falling back to the jnp gather path (VMEM: the q block and the
+#: [rows, page] score tile must fit alongside the fp32 accumulator)
+MAX_KERNEL_Q_ROWS = 4096
 
 
 def token_positions(start_pos: jax.Array, q_len_max: int) -> jax.Array:
@@ -83,21 +91,25 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
     kv_layer : [num_pages+1, page_size, 2, K, D] (new KV already written)
     Returns  : [S, Q, H, D]
 
-    Decode steps (Q == 1) route to the Pallas kernel (``use_kernel``
-    None = auto: on TPU, or anywhere with ``interpret=True``);
-    everything else (prefill / mixed buckets) uses the dense-gather jnp
-    path.  ``interpret`` runs the kernel in Pallas interpret mode (CPU
-    testing), independent of path selection.
+    Ragged buckets route to the Pallas kernel (``use_kernel`` None =
+    auto: on TPU, or anywhere with ``interpret=True``) — the kernel
+    handles ANY Q with per-query causal limits, so a fused mixed
+    prefill+decode step is one kernel launch, not a per-Q-bucket split
+    (arxiv 2604.15464's single-kernel ragged serving).  Oversized query
+    blocks (Q * groups > ``MAX_KERNEL_Q_ROWS``) and the CPU default fall
+    back to the dense-gather jnp path.  ``interpret`` runs the kernel in
+    Pallas interpret mode (CPU testing), independent of path selection.
     """
     S, Q, H, D = q.shape
-    if Q == 1:
-        if use_kernel is None:
-            use_kernel = interpret or jax.default_backend() == "tpu"
-        if use_kernel:
-            return paged_decode_attention(
-                q, kv_layer, page_table, start_pos,
-                sm_scale=sm_scale, alibi_slopes=alibi_slopes,
-                window=window, interpret=interpret)
+    K_heads = kv_layer.shape[3]
+    if use_kernel is None:
+        use_kernel = ((interpret or jax.default_backend() == "tpu")
+                      and Q * (H // K_heads) <= MAX_KERNEL_Q_ROWS)
+    if use_kernel:
+        return paged_decode_attention(
+            q, kv_layer, page_table, start_pos,
+            sm_scale=sm_scale, alibi_slopes=alibi_slopes,
+            window=window, interpret=interpret)
     page_size = kv_layer.shape[1]
     K = kv_layer.shape[3]
     G = H // K
@@ -136,19 +148,26 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
 
 
 # ---------------------------------------------------------------------------
-# Pallas decode kernel (Q = 1)
+# Pallas ragged kernel (any Q: decode rows AND prefill-chunk rows)
 # ---------------------------------------------------------------------------
 
 def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
-                   sm_scale, has_alibi, window):
-    """One (slot, kv_head, page) grid step of flash-style decode.
+                   sm_scale, has_alibi, window, q_len, groups):
+    """One (slot, kv_head, page) grid step of flash-style ragged attention.
 
-    q_ref : [G, D]         (this slot's queries for one kv head)
+    q_ref : [Q*G, D]       (this slot's queries for one kv head; row
+                            r = q_idx * G + g, so per-row causal limit
+                            ctx_len_r = start_pos + r // G + 1)
     k_ref/v_ref : [page_size, D]  (one cache page, DMA'd via the page
                             table — see the index maps in the caller)
     slopes_ref : [1, G]    per-q-head ALiBi slopes — present ONLY when
                             ``has_alibi`` (the kernel is specialized
                             statically so non-ALiBi models pay nothing)
+    Q = 1 is the decode specialization; Q > 1 rows are prefill chunks
+    whose own new tokens are already in the cache (write_kv runs before
+    attention), so the causal mask is exactly the jnp path's
+    ``ctx <= pos``.  Rows beyond a slot's q_len compute garbage that the
+    caller's logits gather / KV null page ignore.
     Scratch m/l/acc carry the running max / denominator / weighted sum
     across the page axis (the innermost, sequential grid dim).
     """
@@ -159,6 +178,7 @@ def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
         q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
     s = pl.program_id(0)
     p = pl.program_id(2)
+    rows = q_len * groups
 
     @pl.when(p == 0)
     def _init():
@@ -166,31 +186,41 @@ def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    ctx_len = sp_ref[s] + 1  # new token at start_pos is already in cache
-    page_valid = p * page_size < ctx_len
+    # the LAST query row sees the longest context; earlier rows mask
+    ctx_len_max = sp_ref[s] + q_len
+    page_valid = p * page_size < ctx_len_max
     if window is not None:
-        # pages wholly below the window start contribute nothing: skip
-        # their DMA compute (the banded-decode analogue of the flash
-        # kernel's k_lo bound)
-        page_valid &= (p + 1) * page_size > ctx_len - window
+        # pages wholly below the FIRST row's window start contribute
+        # nothing: skip their DMA compute (the banded-decode analogue of
+        # the flash kernel's k_lo bound)
+        page_valid &= (p + 1) * page_size > sp_ref[s] + 1 - window
 
     @pl.when(page_valid)
     def _attend():
-        q = q_ref[:]                                   # [G, D]
+        q = q_ref[:]                                   # [Q*G, D]
         k = k_ref[:]                                   # [page, D]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale  # [G, page]
+            preferred_element_type=jnp.float32) * sm_scale  # [Q*G, page]
         ctx = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
         if has_alibi:  # additive bias linear in the absolute key position
-            scores = scores + (slopes_ref[0, :][:, None]
-                               * ctx.astype(jnp.float32))
+            # row r = q_idx * G + g: split the row dim so the per-head
+            # slope is a plain broadcast (Mosaic lowers reshapes and
+            # rank-2 iota; it rejects 1-D iota and in-kernel gathers)
+            page = scores.shape[1]
+            bias = (slopes_ref[0, :][None, :, None]
+                    * ctx.astype(jnp.float32).reshape(
+                        q_len, groups, page))
+            scores = scores + bias.reshape(rows, page)
+        # per-row causal limit: row r is query index r // G
+        ctx_len = (sp_ref[s] + 1 + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0) // groups)
         keep = ctx < ctx_len
         if window is not None:
             keep &= ctx >= ctx_len - window
         scores = jnp.where(keep, scores, MASK_VALUE)
-        m_prev = m_scr[:]                              # [G, 1]
+        m_prev = m_scr[:]                              # [Q*G, 1]
         l_prev = l_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
         pexp = jnp.exp(scores - m_new)
@@ -213,35 +243,42 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
                            alibi_slopes: Optional[jax.Array] = None,
                            window: Optional[int] = None,
                            interpret: bool = False) -> jax.Array:
-    """Pallas decode attention: Q=1 queries over paged KV.
+    """Pallas ragged paged attention: [S, Q] queries over paged KV.
 
-    TPU-native counterpart of the reference's blocked_flash decode atoms
+    TPU-native counterpart of the reference's blocked_flash atoms
     (``inference/v2/kernels/ragged_ops/atom_builder/`` splits sequences
     into KV blocks per thread block; here the page IS the block and the
     page table drives the BlockSpec index map through scalar prefetch).
+    Q = 1 is the classic decode step; Q > 1 rows carry prefill chunks
+    with per-row causal limits, so one launch serves a fused mixed
+    prefill+decode ragged batch (the single-kernel serving formulation
+    of Ragged Paged Attention, arxiv 2604.15464).
 
-    q: [S, 1, H, D]; kv_layer: [num_pages+1, page_size, 2, K, D];
-    page_table: [S, P]; start_pos: [S].  Returns [S, 1, H, D].
+    q: [S, Q, H, D]; kv_layer: [num_pages+1, page_size, 2, K, D];
+    page_table: [S, P]; start_pos: [S].  Returns [S, Q, H, D].
     """
     S, Q, H, D = q.shape
-    assert Q == 1, "decode kernel is specialized to one new token per slot"
     page_size = kv_layer.shape[1]
     K = kv_layer.shape[3]
     G = H // K
     P_pages = page_table.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
 
-    qg = q.reshape(S, K, G, D)  # fold GQA: per kv head, G queries
+    # fold GQA per kv head: [S, K, Q*G, D], row r = q_idx * G + g
+    qg = q.reshape(S, Q, K, G, D).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(S, K, Q * G, D)
     has_alibi = alibi_slopes is not None
 
     grid = (S, K, P_pages)
     # index maps receive (s, k, p, *scalar_prefetch_refs)
-    q_spec = pl.BlockSpec((None, None, G, D), lambda s, k, p, pt, sp: (s, k, 0, 0))
+    q_spec = pl.BlockSpec((None, None, Q * G, D),
+                          lambda s, k, p, pt, sp: (s, k, 0, 0))
     k_spec = pl.BlockSpec((None, page_size, None, None, D),
                           lambda s, k, p, pt, sp: (pt[s, p], 0, 0, k, 0))
     v_spec = pl.BlockSpec((None, page_size, None, None, D),
                           lambda s, k, p, pt, sp: (pt[s, p], 0, 1, k, 0))
-    o_spec = pl.BlockSpec((None, None, G, D), lambda s, k, p, pt, sp: (s, k, 0, 0))
+    o_spec = pl.BlockSpec((None, None, Q * G, D),
+                          lambda s, k, p, pt, sp: (s, k, 0, 0))
 
     in_specs = [q_spec, k_spec, v_spec]
     inputs = (qg, kv_layer, kv_layer)
@@ -254,7 +291,8 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
 
     kernel = functools.partial(
         _decode_kernel, page_size=page_size, num_pages_per_seq=P_pages,
-        sm_scale=scale, has_alibi=has_alibi, window=window)
+        sm_scale=scale, has_alibi=has_alibi, window=window,
+        q_len=Q, groups=G)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -263,16 +301,17 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
             in_specs=in_specs,
             out_specs=o_spec,
             scratch_shapes=[
-                pltpu.VMEM((G, 1), jnp.float32),
-                pltpu.VMEM((G, 1), jnp.float32),
-                pltpu.VMEM((G, D), jnp.float32),
+                pltpu.VMEM((Q * G, 1), jnp.float32),
+                pltpu.VMEM((Q * G, 1), jnp.float32),
+                pltpu.VMEM((Q * G, D), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((S, K, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((S, K, Q * G, D), q.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(page_table.astype(jnp.int32), start_pos.astype(jnp.int32), *inputs)
+    out = out.reshape(S, K, Q, G, D).transpose(0, 2, 1, 3, 4)
     return out.reshape(S, Q, H, D)
 
 

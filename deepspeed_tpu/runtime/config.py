@@ -328,6 +328,30 @@ class CommOptimizationConfig(DeepSpeedConfigModel):
         return self
 
 
+class ServingOptimizationConfig(DeepSpeedConfigModel):
+    """``serving_optimization`` section — the fused serving step's knobs
+    (inference/v2: engine + FastGenScheduler).
+
+    One SplitFuse scheduler step lowers into ONE compiled device program
+    (mixed prefill chunks + decode rows in a unified ragged layout) that
+    also samples on device, so only int32 tokens cross device->host; the
+    scheduler double-buffers steps via a device-side token gather.  Each
+    flag is an escape hatch back to the seed behavior (per-Q-bucket
+    programs, host-side sampling over [n, V] logits, synchronous
+    stepping); ``enabled: false`` flips all three."""
+    enabled: bool = True
+    fused_step: bool = True
+    on_device_sampling: bool = True
+    async_scheduling: bool = True
+
+    def to_v2_dict(self) -> Dict[str, Any]:
+        """The ``serving_optimization`` dict the inference-v2 config
+        consumes (``RaggedInferenceEngineConfig.from_dict``)."""
+        return {"enabled": self.enabled, "fused_step": self.fused_step,
+                "on_device_sampling": self.on_device_sampling,
+                "async_scheduling": self.async_scheduling}
+
+
 class TPUConfig(DeepSpeedConfigModel):
     """TPU-native extension knobs (no reference analogue)."""
     # Mesh axis sizes; -1 = absorb remaining devices.
@@ -389,6 +413,8 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
+    serving_optimization: ServingOptimizationConfig = Field(
+        default_factory=ServingOptimizationConfig)
     tpu: TPUConfig = Field(default_factory=TPUConfig)
 
     # ------------------------------------------------------------------

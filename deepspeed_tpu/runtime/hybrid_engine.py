@@ -77,8 +77,13 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         else:
             model = RaggedInferenceModel(cfg, params,
                                          mesh=self.topology.mesh)
-            self._inflight_engine = InferenceEngineV2(
-                model, RaggedInferenceEngineConfig())
+            # the user's serving_optimization block (escape hatch back
+            # to the split serving path) flows through to the rollout
+            # engine
+            v2cfg = RaggedInferenceEngineConfig.from_dict({
+                "serving_optimization":
+                    self.config.serving_optimization.to_v2_dict()})
+            self._inflight_engine = InferenceEngineV2(model, v2cfg)
         self._inference_params_step = self.global_steps
         return self._inflight_engine
 

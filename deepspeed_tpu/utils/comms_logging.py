@@ -28,6 +28,64 @@ def convert_size(size_bytes: int) -> str:
     return f"{size_bytes / 1024 ** i:.2f} {names[i]}"
 
 
+class ServingCounters:
+    """Per-process serving-step transfer/program accounting.
+
+    The fused serving step's claim is "one device program and one
+    token-sized host transfer per scheduler step" — these counters make
+    that measured rather than assumed (ISSUE 2).  The engine records
+    every compiled-program dispatch and the host→device bytes of the
+    batch arrays it feeds; the scheduler records step boundaries and the
+    device→host bytes it ACTUALLY syncs (``np.asarray`` sites).
+    Vocab-wide ``[n, V]`` logits buffers handed across the put()
+    contract are tracked separately (``logits_exposed_bytes``): they are
+    materialized device buffers whose sync is the caller's choice — the
+    fused sampling path never creates them at all."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.programs = 0            # compiled-step dispatches
+        self.steps = 0               # scheduler steps
+        self.h2d_bytes = 0           # batch/sampling arrays fed to programs
+        self.d2h_bytes = 0           # bytes actually synced to host
+        self.logits_exposed_bytes = 0  # [n, V] buffers returned by put()
+
+    def record_step(self) -> None:
+        self.steps += 1
+
+    def record_program(self, h2d_bytes: int = 0) -> None:
+        self.programs += 1
+        self.h2d_bytes += int(h2d_bytes)
+
+    def record_h2d(self, nbytes: int) -> None:
+        self.h2d_bytes += int(nbytes)
+
+    def record_d2h(self, nbytes: int) -> None:
+        self.d2h_bytes += int(nbytes)
+
+    def record_logits_exposed(self, nbytes: int) -> None:
+        self.logits_exposed_bytes += int(nbytes)
+
+    def snapshot(self) -> Dict[str, Any]:
+        steps = max(self.steps, 1)
+        return {
+            "programs": self.programs,
+            "steps": self.steps,
+            "programs_per_step": round(self.programs / steps, 3),
+            "h2d_bytes_per_step": self.h2d_bytes // steps,
+            "d2h_bytes_per_step": self.d2h_bytes // steps,
+            "logits_exposed_bytes_per_step":
+                self.logits_exposed_bytes // steps,
+        }
+
+
+#: process-wide singleton — the serving stack is single-engine per
+#: process (the bench and tests reset() around measured windows)
+serving_counters = ServingCounters()
+
+
 class CommsLogger:
     def __init__(self, enabled: bool = True, verbose: bool = False, debug: bool = False):
         self.enabled = enabled

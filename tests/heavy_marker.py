@@ -34,6 +34,13 @@ SLOW_TESTS = frozenset([
     "tests/test_collective_scheduler.py::TestQuantizedWire::test_no_error_feedback_still_converges",  # ~10s, 2 engines
     "tests/test_collective_scheduler.py::TestBucketing::test_overlap_off_matches_tolerance",  # ~12s, 3 engines
     "tests/test_multiprocess.py::TestMultiProcess::test_zero3_param_sharding_across_processes",  # ~13s, 2-proc rendezvous
+    "tests/test_fused_serving.py::TestSamplingLattice::test_precompiled_lattice_covers_fused_serving_under_strict",  # ~50s, full sample/chain lattice AOT (newly added; strict coverage of the lattice itself is in tier-1 via TestPrecompileLattice)
+    "tests/test_fused_serving.py::TestAsyncScheduling::test_preemption_and_restore_under_async_loop",  # 11.5s, newly added; tier-1 keeps preemption-under-async via test_inference_v2's seed preemption test (default serving is fused+async)
+    "tests/test_fused_serving.py::TestSamplingLattice::test_strict_lattice_without_sampling_falls_back_to_split",  # 8.2s, newly added strict-mode fallback
+    "tests/test_fused_serving.py::TestSamplingLattice::test_strict_prefill_superbucket_outside_lattice_serves_split",  # ~87s, full sampling-lattice AOT (newly added strict superbucket regression)
+    "tests/test_fused_serving.py::TestFusedSplitParity::test_prefill_only_step",  # 6.6s, newly added (mixed-step parity stays in tier-1)
+    "tests/test_fused_serving.py::TestFusedSplitParity::test_decode_only_step",  # 4.9s, newly added (mixed-step parity stays in tier-1)
+    "tests/test_fused_serving.py::TestAsyncScheduling::test_async_matches_sync_fused_greedy",  # 4.2s, newly added (async==split parity stays in tier-1)
 ])
 
 HEAVY_TESTS = frozenset([
@@ -64,6 +71,8 @@ HEAVY_TESTS = frozenset([
     "tests/test_engine.py::test_zero_stages_match_numerically",  # 12.65s
     "tests/test_inference_v1.py::test_hybrid_engine_train_and_generate",  # 23.83s
     "tests/test_inference_v1.py::test_init_inference_generate_and_forward",  # 9.00s
+    "tests/test_fused_serving.py::TestAsyncScheduling::test_stop_token_misprediction_rolls_back",  # 8.2s
+    "tests/test_fused_serving.py::TestAsyncScheduling::test_async_matches_split_greedy",  # 4.6s
     "tests/test_inference_v2.py::TestEndToEnd::test_chunked_prefill_then_decode_matches_full",  # 5.95s
     "tests/test_inference_v2.py::TestEndToEnd::test_generate_matches_engine_greedy",  # 20.82s
     "tests/test_inference_v2.py::TestPrecompileLattice::test_precompile_covers_serving_and_strict_catches_misses",  # 147.61s
