@@ -71,6 +71,11 @@ class AioHandle {
   }
 
   ~AioHandle() {
+    // 1. Stop part claims and join workers FIRST: once they are gone,
+    //    no thread touches request buffers — a waiter woken below may
+    //    have its caller free the buffer immediately, which must not
+    //    race a worker's in-flight pread/pwrite.  Each worker finishes
+    //    at most its current block_size part, so the join is bounded.
     {
       std::unique_lock<std::mutex> lk(mu_);
       stop_ = true;
@@ -78,6 +83,21 @@ class AioHandle {
     }
     cv_.notify_all();
     for (auto& w : workers_) w.join();
+    // 2. Requests with unclaimed parts can now never reach done — mark
+    //    them done with a cancellation error so threads blocked in
+    //    wait()/wait_all() wake up instead of hanging forever, and
+    //    drain those waiters before members are destroyed (they still
+    //    take mu_ / erase from inflight_ on their way out).
+    std::unique_lock<std::mutex> lk(mu_);
+    for (auto& kv : inflight_) {
+      if (!kv.second->done) {
+        int64_t expected = 0;
+        kv.second->error.compare_exchange_strong(expected, -ECANCELED);
+        kv.second->done = true;
+      }
+    }
+    done_cv_.notify_all();
+    drained_cv_.wait(lk, [&] { return waiters_ == 0; });
     for (auto& kv : inflight_) close_req(*kv.second);
   }
 
@@ -124,8 +144,12 @@ class AioHandle {
     auto it = inflight_.find(id);
     if (it == inflight_.end()) return -EINVAL;
     auto req = it->second;
+    ++waiters_;
     done_cv_.wait(lk, [&] { return req->done; });
+    --waiters_;
+    drained_cv_.notify_all();
     inflight_.erase(id);
+    close_req(*req);  // cancelled requests never ran their last part
     int64_t err = req->error.load();
     return err < 0 ? err : req->moved.load();
   }
@@ -133,14 +157,19 @@ class AioHandle {
   // Returns 0 if all inflight requests completed OK, else first error code.
   int64_t wait_all() {
     std::unique_lock<std::mutex> lk(mu_);
+    ++waiters_;
     done_cv_.wait(lk, [&] {
       for (auto& kv : inflight_)
         if (!kv.second->done) return false;
       return true;
     });
+    --waiters_;
+    drained_cv_.notify_all();
     int64_t rc = 0;
-    for (auto& kv : inflight_)
+    for (auto& kv : inflight_) {
       if (kv.second->error.load() < 0 && rc == 0) rc = kv.second->error.load();
+      close_req(*kv.second);  // cancelled requests never closed their fd
+    }
     inflight_.clear();
     return rc;
   }
@@ -188,21 +217,30 @@ class AioHandle {
     }
   }
 
-  // Claim the next part of the first active request with spare
-  // queue_depth slots; prunes fully-claimed requests.  mu_ held.
+  // Claim the next part of the earliest active request with spare
+  // queue_depth slots; prunes fully-claimed requests.  A depth-capped
+  // request no longer blocks the whole line — workers scan past it so a
+  // later request's parts proceed (FIFO preference, not FIFO blocking).
+  // mu_ held.
   bool claimable(std::unique_lock<std::mutex>&, std::shared_ptr<Request>& req,
                  int& part_idx) {
-    while (!active_.empty()) {
-      auto& front = active_.front();
-      if (front->next_part.load() >= front->nparts) {
-        active_.pop_front();
+    for (auto it = active_.begin(); it != active_.end();) {
+      auto& cand = *it;
+      if (cand->next_part.load() >= cand->nparts) {
+        it = active_.erase(it);
         continue;
       }
-      if (front->running_parts.load() >= queue_depth_) return false;
-      int p = front->next_part.fetch_add(1);
-      if (p >= front->nparts) continue;  // lost the race to the last part
-      front->running_parts.fetch_add(1);
-      req = front;
+      if (cand->running_parts.load() >= queue_depth_) {
+        ++it;  // depth-capped: scan past, don't head-of-line block
+        continue;
+      }
+      int p = cand->next_part.fetch_add(1);
+      if (p >= cand->nparts) {  // lost the race to the last part
+        ++it;
+        continue;
+      }
+      cand->running_parts.fetch_add(1);
+      req = cand;
       part_idx = p;
       return true;
     }
@@ -232,6 +270,8 @@ class AioHandle {
   std::mutex mu_;
   std::condition_variable cv_;       // parts claimable
   std::condition_variable done_cv_;  // completions
+  std::condition_variable drained_cv_;  // destructor: waiters all left
+  int waiters_ = 0;  // threads inside wait()/wait_all() (mu_ held)
   std::deque<std::shared_ptr<Request>> active_;  // requests with parts left
   std::unordered_map<int64_t, std::shared_ptr<Request>> inflight_;
   std::vector<std::thread> workers_;
@@ -253,23 +293,32 @@ void* ds_aio_create2(int nthreads, int block_size, int queue_depth,
 
 void ds_aio_destroy(void* handle) { delete static_cast<AioHandle*>(handle); }
 
+// Null-handle guards: the ctypes wrapper clears its handle on close(),
+// so calls issued AFTER close() returns get -EINVAL instead of a null
+// deref.  (A call truly concurrent with ds_aio_destroy remains the
+// caller's race to avoid — the check cannot see a delete that lands
+// between it and the method body.)
 int64_t ds_aio_pwrite(void* handle, const char* path, char* buf,
                       int64_t nbytes, int64_t offset) {
+  if (!handle) return -EINVAL;
   return static_cast<AioHandle*>(handle)->submit(true, path, buf, nbytes,
                                                  offset);
 }
 
 int64_t ds_aio_pread(void* handle, const char* path, char* buf, int64_t nbytes,
                      int64_t offset) {
+  if (!handle) return -EINVAL;
   return static_cast<AioHandle*>(handle)->submit(false, path, buf, nbytes,
                                                  offset);
 }
 
 int64_t ds_aio_wait(void* handle, int64_t request_id) {
+  if (!handle) return -EINVAL;
   return static_cast<AioHandle*>(handle)->wait(request_id);
 }
 
 int64_t ds_aio_wait_all(void* handle) {
+  if (!handle) return -EINVAL;
   return static_cast<AioHandle*>(handle)->wait_all();
 }
 

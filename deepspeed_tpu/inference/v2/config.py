@@ -51,6 +51,12 @@ class ServingOptimizationConfig:
     #: token gather) while step k's tokens are in flight — token values
     #: reach the host one step late
     async_scheduling: bool = True
+    #: automatic prefix cache over the paged KV pool (ISSUE 3): shared
+    #: full prompt pages are ref-count-attached across sequences and
+    #: completed sequences' pages are retained (LRU-evicted under pool
+    #: pressure), so warm-prefix admission only prefills the uncached
+    #: suffix.  Off: every request re-prefills its whole prompt (seed)
+    prefix_caching: bool = True
 
 
 @dataclasses.dataclass
@@ -84,7 +90,7 @@ class RaggedInferenceEngineConfig:
             # the master escape hatch wins over individual flags
             cfg.serving = ServingOptimizationConfig(
                 fused_step=False, on_device_sampling=False,
-                async_scheduling=False)
+                async_scheduling=False, prefix_caching=False)
         else:
             for k, v in srv.items():
                 if hasattr(cfg.serving, k):

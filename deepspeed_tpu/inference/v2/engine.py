@@ -83,7 +83,8 @@ class InferenceEngineV2:
         self._state = StateManager(
             kv_cfg,
             max_tracked_sequences=self._config.state_manager.max_tracked_sequences,
-            kv_sharding=model.kv_sharding())
+            kv_sharding=model.kv_sharding(),
+            prefix_caching=self._config.serving.prefix_caching)
 
     def precompile(self, max_prompt: int, max_concurrency: int = 0,
                    max_new_tokens: int = 256,
@@ -265,10 +266,13 @@ class InferenceEngineV2:
     def _commit_batch(self, descs) -> None:
         """Shared put/step epilogue: commit host bookkeeping (the token
         VALUES may still be in flight on device — only counts matter
-        here) and run sliding-window page eviction."""
+        here), index newly-full prompt pages into the prefix cache, and
+        run sliding-window page eviction (in that order: an indexed page
+        the window then releases stays cache-retained)."""
         window = getattr(self._model.cfg, "sliding_window", None)
         for sd in descs:
             sd.post_forward()
+            self._state.index_prefix(sd)
             if window:
                 # Mistral serving: pages wholly outside the window are
                 # unreachable for every future query — return them to the
@@ -476,6 +480,32 @@ class InferenceEngineV2:
             temps, top_ks, top_ps, greedy_only)
         self._commit_batch(descs)
         return tokens
+
+    # -- prefix cache (ISSUE 3) ---------------------------------------------
+    def match_prefix(self, uid: int, prompt: Sequence[int]) -> int:
+        """Attach the longest prefix-cache hit for a NEW sequence's
+        prompt: matched full pages join its block table read-only
+        (allocator refcounts track the sharers) and ``seen_tokens``
+        advances past them, so the scheduler only prefills the uncached
+        suffix.  Registers the prompt for indexing either way.  Returns
+        the number of tokens served from the cache (0 on miss, caching
+        off, or an already-started sequence)."""
+        if self._state.prefix_cache is None:
+            return 0
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if (self._state.get_sequence(uid) is None
+                and self._state.n_tracked_sequences
+                >= self._config.state_manager.max_tracked_sequences):
+            return 0  # don't create a sequence the manager can't track
+        sd = self._state.get_or_create_sequence(uid)
+        hit = self._state.match_prefix(sd, prompt)
+        serving_counters.record_prefix_lookup(len(prompt), hit)
+        return hit
+
+    def reset_prefix_cache(self) -> None:
+        """Drop every cache entry and return parked pages to the pool
+        (bench/test cold-start control)."""
+        self._state.reset_prefix_cache()
 
     def flush(self, uid: int) -> None:
         self._state.flush_sequence(uid)

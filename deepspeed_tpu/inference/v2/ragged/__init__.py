@@ -2,10 +2,11 @@ from .blocked_allocator import NULL_PAGE, BlockedAllocator
 from .batch import RaggedBatch, build_batch
 from .kv_cache import BlockedKVCache, KVCacheConfig, pages_for_memory
 from .manager import StateManager
+from .prefix_cache import PrefixCache
 from .sequence import SequenceDescriptor, placeholder
 
 __all__ = [
     "NULL_PAGE", "BlockedAllocator", "RaggedBatch", "build_batch",
     "BlockedKVCache", "KVCacheConfig", "pages_for_memory", "StateManager",
-    "SequenceDescriptor", "placeholder",
+    "PrefixCache", "SequenceDescriptor", "placeholder",
 ]

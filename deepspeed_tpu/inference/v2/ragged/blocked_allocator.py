@@ -1,4 +1,4 @@
-"""Free-list allocator for KV-cache pages.
+"""Free-list allocator for KV-cache pages, with per-page reference counts.
 
 TPU-native rework of the reference ``BlockedAllocator``
 (``inference/v2/ragged/blocked_allocator.py:11`` — linked-list over a
@@ -10,11 +10,22 @@ Page index 0 is reserved as the **null page**: padding tokens in a
 ragged batch scatter their (masked, garbage) KV writes into it, which
 keeps every shape static without conditional writes.  Valid pages are
 therefore 1..num_pages inclusive.
+
+Prefix caching (ISSUE 3) adds two layers of host bookkeeping:
+
+* **refcounts** — a full page holding a shared prompt prefix can sit in
+  several sequences' block tables at once; ``add_ref``/``decref`` track
+  the sharers and a page only becomes reclaimable at refcount zero.
+* **allocated bitmap** — every page is either on the free list, *live*
+  (refcount >= 1) or *parked* (allocated, refcount 0: retained by the
+  prefix cache awaiting reuse or LRU eviction).  Freeing a page that is
+  already free — the double-free that used to silently corrupt the link
+  table and hand the same page to two sequences — now raises.
 """
 
 from __future__ import annotations
 
-from typing import Iterable, Union
+from typing import Iterable, List, Union
 
 import numpy as np
 
@@ -33,6 +44,16 @@ class BlockedAllocator:
         self._next = np.arange(2, num_pages + 2, dtype=np.int64)
         self._head = 1
         self._free = num_pages
+        # page -> number of block tables referencing it (0 while free or
+        # parked); _allocated[p] is False exactly while p is on the free
+        # list.  Index 0 (the null page) is never allocated.
+        self._refs = np.zeros(num_pages + 1, dtype=np.int64)
+        self._allocated = np.zeros(num_pages + 1, dtype=bool)
+        # incremental parked count (allocated, refcount 0): free_pages /
+        # parked_pages / live_pages sit on the per-step scheduling hot
+        # path, so they must not scan the arrays; audit() re-derives
+        # them under DS_KV_DEBUG
+        self._parked = 0
 
     @property
     def free_pages(self) -> int:
@@ -42,6 +63,50 @@ class BlockedAllocator:
     def total_pages(self) -> int:
         return self._num_pages
 
+    @property
+    def live_pages(self) -> int:
+        """Pages referenced by at least one block table."""
+        return self._num_pages - self._free - self._parked
+
+    @property
+    def parked_pages(self) -> int:
+        """Allocated pages with refcount 0 — retained by the prefix
+        cache, reclaimable on demand."""
+        return self._parked
+
+    def parked_page_ids(self) -> np.ndarray:
+        return np.nonzero(self._allocated & (self._refs == 0))[0]
+
+    def audit(self) -> None:
+        """Re-derive the incremental counters from the arrays and raise
+        on drift (DS_KV_DEBUG invariant check; O(total pages))."""
+        parked = int((self._allocated & (self._refs == 0)).sum())
+        if parked != self._parked:
+            raise RuntimeError(
+                f"allocator audit: parked counter {self._parked} != "
+                f"array state {parked}")
+        allocated = int(self._allocated.sum())
+        if self._free + allocated != self._num_pages:
+            raise RuntimeError(
+                f"allocator audit: free({self._free}) + "
+                f"allocated({allocated}) != total({self._num_pages})")
+
+    def _check_page(self, p: int) -> int:
+        p = int(p)
+        if not (1 <= p <= self._num_pages):
+            raise ValueError(f"invalid page index {p}")
+        return p
+
+    def ref_count(self, page: int) -> int:
+        return int(self._refs[self._check_page(page)])
+
+    def is_allocated(self, page: int) -> bool:
+        return bool(self._allocated[self._check_page(page)])
+
+    def is_parked(self, page: int) -> bool:
+        p = self._check_page(page)
+        return bool(self._allocated[p]) and self._refs[p] == 0
+
     def allocate(self, num_pages: int) -> np.ndarray:
         if num_pages > self._free:
             raise ValueError(
@@ -49,16 +114,67 @@ class BlockedAllocator:
         out = np.empty(num_pages, dtype=np.int32)
         for i in range(num_pages):
             out[i] = self._head
+            self._allocated[self._head] = True
+            self._refs[self._head] = 1
             self._head = int(self._next[self._head - 1])
         self._free -= num_pages
         return out
 
-    def free(self, pages: Union[Iterable[int], np.ndarray]) -> None:
+    def add_ref(self, pages: Union[Iterable[int], np.ndarray]) -> None:
+        """Attach ``pages`` to one more block table.  Valid for live
+        pages (sharing) and parked pages (a prefix-cache hit reviving a
+        retained page); never for free-list pages."""
+        for p in np.atleast_1d(np.asarray(pages, dtype=np.int64)):
+            p = self._check_page(p)
+            if not self._allocated[p]:
+                raise ValueError(
+                    f"add_ref of free page {p} (not allocated)")
+            if self._refs[p] == 0:
+                self._parked -= 1
+            self._refs[p] += 1
+
+    def decref(self, pages: Union[Iterable[int], np.ndarray]) -> List[int]:
+        """Detach ``pages`` from one block table; returns the pages that
+        reached refcount zero.  Zero-ref pages stay ALLOCATED (parked) —
+        the caller decides between ``reclaim`` (back to the free list)
+        and prefix-cache retention.  Raises on double-free (page already
+        on the free list) and refcount underflow (parked page)."""
+        zeroed: List[int] = []
+        for p in np.atleast_1d(np.asarray(pages, dtype=np.int64)):
+            p = self._check_page(p)
+            if not self._allocated[p]:
+                raise ValueError(
+                    f"double free of page {p}: already on the free list")
+            if self._refs[p] <= 0:
+                raise ValueError(
+                    f"refcount underflow on page {p}: parked (cache-"
+                    "retained) pages must be reclaimed, not freed")
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._parked += 1
+                zeroed.append(p)
+        return zeroed
+
+    def reclaim(self, pages: Union[Iterable[int], np.ndarray]) -> None:
+        """Return parked (allocated, zero-ref) pages to the free list."""
         pages = np.atleast_1d(np.asarray(pages, dtype=np.int64))
         for p in pages:
-            p = int(p)
-            if not (1 <= p <= self._num_pages):
-                raise ValueError(f"invalid page index {p}")
+            p = self._check_page(p)
+            if not self._allocated[p]:
+                raise ValueError(
+                    f"double free of page {p}: already on the free list")
+            if self._refs[p] != 0:
+                raise ValueError(
+                    f"reclaim of live page {p} (refcount {self._refs[p]})")
+            self._allocated[p] = False
+            self._parked -= 1
             self._next[p - 1] = self._head
             self._head = p
         self._free += len(pages)
+
+    def free(self, pages: Union[Iterable[int], np.ndarray]) -> None:
+        """Detach and immediately reclaim whatever reaches refcount
+        zero (the non-prefix-cached release path)."""
+        pages = np.atleast_1d(np.asarray(pages, dtype=np.int64))
+        if len(pages):
+            self.reclaim(self.decref(pages))

@@ -79,6 +79,12 @@ class BlockedKVCache:
         return self.allocator.allocate(num_pages)
 
     def release(self, pages) -> None:
+        """Drop one reference per page and reclaim what reaches zero.
+        Prefix-shared pages survive their other holders (allocator
+        refcounts); double-freeing a page raises instead of silently
+        corrupting the free list.  Cache-retention release paths live in
+        ``StateManager._release_pages`` (pages the prefix cache still
+        indexes are parked, not reclaimed)."""
         if len(pages):
             self.allocator.free(pages)
 

@@ -1,23 +1,41 @@
 """Persistent state manager: tracked sequences + blocked KV cache.
 
 Reference: ``inference/v2/ragged/ragged_manager.py:19`` (``DSStateManager``).
+
+Prefix caching (ISSUE 3): the manager owns the :class:`PrefixCache` and
+is the single choke point for page lifetime, so every release path
+(flush, preemption offload, sliding-window eviction) is shared-page
+aware — a page leaves the device pool only when its last sharer drops
+it AND the prefix cache no longer retains it.  ``free_pages`` reports
+free-list pages plus cache-parked pages: the cache is exactly the
+otherwise-idle pool, reclaimed LRU on allocator pressure, so admission
+accounting and steady-state capacity are unchanged.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from collections import Counter
+from typing import Dict, List, Optional
 
+import numpy as np
+
+from ....utils.comms_logging import serving_counters
+from .blocked_allocator import NULL_PAGE
 from .kv_cache import BlockedKVCache, KVCacheConfig
+from .prefix_cache import PrefixCache
 from .sequence import SequenceDescriptor
 
 
 class StateManager:
     def __init__(self, kv_config: KVCacheConfig,
                  max_tracked_sequences: int = 2048,
-                 kv_sharding=None):
+                 kv_sharding=None,
+                 prefix_caching: bool = True):
         self.kv_config = kv_config
         self.max_tracked_sequences = max_tracked_sequences
         self.kv_cache = BlockedKVCache(kv_config, sharding=kv_sharding)
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(kv_config.page_size) if prefix_caching else None)
         self._seqs: Dict[int, SequenceDescriptor] = {}
 
     # -- sequence tracking --------------------------------------------------
@@ -27,7 +45,12 @@ class StateManager:
 
     @property
     def free_pages(self) -> int:
-        return self.kv_cache.free_pages
+        """Schedulable pages: the free list plus cache-parked pages
+        (reclaimed on demand by ``ensure_free``)."""
+        free = self.kv_cache.free_pages
+        if self.prefix_cache is not None:
+            free += self.kv_cache.allocator.parked_pages
+        return free
 
     def get_sequence(self, uid: int) -> Optional[SequenceDescriptor]:
         return self._seqs.get(uid)
@@ -42,27 +65,145 @@ class StateManager:
             self._seqs[uid] = sd
         return sd
 
+    # -- shared-page-aware release ------------------------------------------
+    def _release_pages(self, pages: List[int]) -> None:
+        """Drop one table reference from each page.  Pages whose last
+        sharer left are PARKED when the prefix cache still indexes them
+        (retention: refcount 0, allocated, reclaimable LRU) and returned
+        to the free list otherwise."""
+        if not pages:
+            return
+        alloc = self.kv_cache.allocator
+        zeroed = alloc.decref(pages)
+        if not zeroed:
+            return
+        if self.prefix_cache is None:
+            alloc.reclaim(zeroed)
+            return
+        reclaim = []
+        for p in zeroed:
+            if self.prefix_cache.contains_page(p):
+                # retained: was in use until this very release
+                self.prefix_cache.touch_page(p)
+            else:
+                reclaim.append(p)
+        if reclaim:
+            alloc.reclaim(reclaim)
+
+    def ensure_free(self, num_pages: int) -> None:
+        """Make the free list hold ``num_pages`` by LRU-evicting parked
+        prefix-cache pages if needed (no-op when already satisfied)."""
+        alloc = self.kv_cache.allocator
+        deficit = num_pages - alloc.free_pages
+        if deficit <= 0 or self.prefix_cache is None:
+            return
+        evicted = self.prefix_cache.evict(deficit, alloc.is_parked)
+        if evicted:
+            alloc.reclaim(evicted)
+            serving_counters.record_prefix_evicted(len(evicted))
+
+    # -- prefix cache -------------------------------------------------------
+    def match_prefix(self, sd: SequenceDescriptor,
+                     prompt: np.ndarray) -> int:
+        """Attach the longest cached prefix of ``prompt`` to a FRESH
+        sequence: full pages only (the trailing partial page is never
+        shared), and at least one suffix token is always left to prefill
+        (the step needs last-token logits).  Registers the prompt for
+        indexing either way.  Returns the tokens attached."""
+        if self.prefix_cache is None or sd.seen_tokens or sd.pages \
+                or sd.host_blob is not None:
+            return 0  # started sequences keep their original registration
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        sd.prompt_tokens = prompt
+        page = self.kv_config.page_size
+        max_pages = (len(prompt) - 1) // page
+        if max_pages <= 0:
+            return 0
+        pages, digest = self.prefix_cache.match(prompt, max_pages)
+        if not pages:
+            return 0
+        self.kv_cache.allocator.add_ref(pages)
+        sd.pages = [int(p) for p in pages]
+        sd.seen_tokens = len(pages) * page
+        sd.indexed_pages = len(pages)
+        sd.last_digest = digest
+        return sd.seen_tokens
+
+    def index_prefix(self, sd: SequenceDescriptor) -> None:
+        """Index newly-committed FULL prompt pages (called after each
+        commit).  Generated-token pages (positions past the prompt) are
+        never indexed, so the page a chained decode step optimistically
+        writes is never a cache page."""
+        if self.prefix_cache is None or sd.prompt_tokens is None:
+            return
+        page = self.kv_config.page_size
+        full = min(sd.seen_tokens, len(sd.prompt_tokens)) // page
+        for i in range(sd.indexed_pages, full):
+            digest = self.prefix_cache.chain(
+                sd.last_digest, sd.prompt_tokens[i * page:(i + 1) * page])
+            p = sd.pages[i] if i < len(sd.pages) else NULL_PAGE
+            if p != NULL_PAGE:  # window-evicted slots can't be indexed
+                self.prefix_cache.insert(digest, int(p))
+            sd.last_digest = digest
+            sd.indexed_pages = i + 1
+
+    def reset_prefix_cache(self) -> None:
+        """Drop the whole index and reclaim its parked pages (bench
+        cold-start; live sequences' pages free normally at flush)."""
+        if self.prefix_cache is None:
+            return
+        alloc = self.kv_cache.allocator
+        parked = [p for p in self.prefix_cache.clear()
+                  if alloc.is_parked(p)]
+        if parked:
+            alloc.reclaim(parked)
+
+    # -- lifecycle ----------------------------------------------------------
+    def offloadable_slots(self, sd: SequenceDescriptor) -> List[int]:
+        """Table slots an offload would actually move to host: non-null
+        and privately held (refcount 1).  Shared pages stay resident —
+        the scheduler's preemption-victim ranking uses this same
+        predicate so a fully-shared victim can't be picked for a no-op
+        offload."""
+        alloc = self.kv_cache.allocator
+        return [i for i, p in enumerate(sd.pages)
+                if p != NULL_PAGE and alloc.ref_count(p) == 1]
+
     def flush_sequence(self, uid: int) -> None:
         sd = self._seqs.pop(uid, None)
         if sd is not None:
             # window eviction leaves null-page placeholders — not ours
-            self.kv_cache.release([p for p in sd.pages if p != 0])
+            self._release_pages([p for p in sd.pages if p != NULL_PAGE])
 
     def offload_sequence(self, uid: int) -> None:
-        """Preempt: move a sequence's live KV pages to host memory and
-        free them (reference kv_cache offload hook).  The sequence stays
+        """Preempt: move a sequence's PRIVATE live KV pages to host
+        memory and free them (reference kv_cache offload hook).  Shared
+        pages (another sequence's table also holds them) stay resident —
+        freeing them would yank KV from under the sharers; privately-
+        held pages the cache indexes are unindexed and offloaded (the
+        point of preemption is reclaiming memory).  The sequence stays
         tracked; it cannot be scheduled until restore_sequence."""
         sd = self._seqs.get(uid)
         if sd is None or sd.host_blob is not None:
             return  # unknown/flushed uids tolerated like flush_sequence
-        sd.live_slots = [i for i, p in enumerate(sd.pages) if p != 0]
+        sd.live_slots = self.offloadable_slots(sd)
         live = [sd.pages[i] for i in sd.live_slots]
         if not live:
             sd.host_blob = None
             return
+        if self.prefix_cache is not None:
+            dropped = [p for p in live if self.prefix_cache.contains_page(p)]
+            if dropped:
+                self.prefix_cache.drop_pages(dropped)
+                # the sequence's digest chain now passes through
+                # unindexed pages: any page indexed past the break could
+                # never be matched (match() walks from the root), so
+                # stop indexing this sequence rather than fill the cache
+                # with unmatchable entries that flush would then park
+                sd.prompt_tokens = None
         sd.host_blob = self.kv_cache.offload_pages(live)
         for i in sd.live_slots:
-            sd.pages[i] = 0
+            sd.pages[i] = NULL_PAGE
 
     def restore_sequence(self, uid: int) -> None:
         """Bring a preempted sequence's KV back onto device (reference
@@ -70,23 +211,29 @@ class StateManager:
         sd = self._seqs.get(uid)
         if sd is None or sd.host_blob is None:
             return
+        self.ensure_free(int(sd.host_blob.shape[1]))
         pages = self.kv_cache.restore_pages(sd.host_blob)
         for slot, p in zip(sd.live_slots, pages):
             sd.pages[slot] = int(p)
         sd.host_blob = None
         sd.live_slots = []
+        # restored pages are private again; if offload unindexed any of
+        # them it also disabled this sequence's indexing (broken chain),
+        # otherwise the digest chain is intact and indexing continues
 
     def evict_window(self, sd: SequenceDescriptor, window: int) -> int:
-        """Free every page wholly below ``seen_tokens - window + 1`` (the
-        earliest position any future query can attend).  Returns the
-        number of pages freed."""
+        """Release every page wholly below ``seen_tokens - window + 1``
+        (the earliest position any future query can attend).  Shared
+        pages just lose this sequence's reference — the sharers (and the
+        prefix cache's retention) keep them alive.  Returns the number
+        of table slots cleared."""
         min_attended = sd.seen_tokens - window + 1
         if min_attended <= 0:
             return 0
         first_live = min_attended // self.kv_config.page_size
         freed = sd.evict_pages_below(first_live)
         if freed:
-            self.kv_cache.release(freed)
+            self._release_pages(freed)
         return len(freed)
 
     # -- KV accounting ------------------------------------------------------
@@ -100,4 +247,50 @@ class StateManager:
     def allocate_for(self, sd: SequenceDescriptor, n_new_tokens: int) -> None:
         extra = self.pages_needed(sd, n_new_tokens)
         if extra:
+            self.ensure_free(extra)
             sd.extend_pages(self.kv_cache.reserve(extra))
+
+    # -- invariants (DS_KV_DEBUG) -------------------------------------------
+    def check_invariants(self) -> None:
+        """O(live pages) page-accounting audit:
+        ``free + live + parked == total``, every block-table reference
+        is backed by exactly one allocator ref, and every parked page is
+        still prefix-cache indexed.  Raises RuntimeError on violation —
+        wired into FastGenScheduler.step under ``DS_KV_DEBUG=1`` so
+        scheduler changes can't silently leak or double-use pages."""
+        alloc = self.kv_cache.allocator
+        refs = Counter()
+        for sd in self._seqs.values():
+            for p in sd.pages:
+                if p != NULL_PAGE:
+                    refs[p] += 1
+        for p, n in refs.items():
+            if not alloc.is_allocated(p):
+                raise RuntimeError(
+                    f"KV invariant: page {p} is in a block table but on "
+                    "the free list")
+            if alloc.ref_count(p) != n:
+                raise RuntimeError(
+                    f"KV invariant: page {p} has allocator refcount "
+                    f"{alloc.ref_count(p)} but appears in {n} block "
+                    "tables")
+        live, parked = alloc.live_pages, alloc.parked_pages
+        if live != len(refs):
+            raise RuntimeError(
+                f"KV invariant: allocator sees {live} live pages, block "
+                f"tables reference {len(refs)}")
+        if alloc.free_pages + live + parked != alloc.total_pages:
+            raise RuntimeError(
+                f"KV invariant: free({alloc.free_pages}) + live({live}) "
+                f"+ cached({parked}) != total({alloc.total_pages})")
+        if parked:
+            if self.prefix_cache is None:
+                raise RuntimeError(
+                    f"KV invariant: {parked} parked pages with prefix "
+                    "caching off")
+            indexed = set(self.prefix_cache.pages())
+            for p in alloc.parked_page_ids():
+                if int(p) not in indexed:
+                    raise RuntimeError(
+                        f"KV invariant: parked page {int(p)} is not "
+                        "prefix-cache indexed (leaked)")

@@ -1,0 +1,149 @@
+"""Automatic prefix cache over the blocked KV pool (ISSUE 3).
+
+Real serving traffic is dominated by shared prefixes — system prompts,
+few-shot templates, multi-turn history.  The paged layout makes sharing
+pure host bookkeeping: the device only ever sees page *indices* in a
+block table, so a full page of committed prefix KV can appear in any
+number of sequences' tables at once (the allocator's refcounts track the
+sharers).
+
+The index is a chained hash at **page granularity**: page i of a prompt
+is keyed by
+
+    digest_i = blake2b(digest_{i-1} || tokens[i*page : (i+1)*page])
+
+so a digest identifies the *cumulative* token prefix, not just one
+page's tokens — two prompts sharing page 3's tokens but differing in
+page 0 never collide.  Matching walks the chain from the root and stops
+at the first miss, yielding the longest cached prefix; 128-bit blake2b
+makes accidental collision a non-concern.
+
+Copy-on-write rule: only FULL pages are ever indexed or attached — the
+trailing partial page of a prompt is always freshly allocated and owned
+by its sequence, and decode appends to owned pages only, so shared pages
+are immutable by construction and no KV bytes are ever copied.
+
+Retention/eviction: completed sequences' indexed pages are *parked*
+(allocated, refcount 0, still indexed) instead of returned to the pool —
+the cache is exactly the otherwise-idle pool.  Under allocator pressure
+``evict`` reclaims parked pages in LRU order; pages still referenced by
+live sequences cost nothing and are skipped.  Evicting a mid-chain page
+orphans its descendants from future matches (they stay individually
+reclaimable), which keeps eviction O(1) per page instead of maintaining
+a radix tree.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Callable, Iterable, List, Tuple
+
+import numpy as np
+
+
+class PrefixCache:
+    """Host-side chained-hash index: cumulative page digest -> page id."""
+
+    def __init__(self, page_size: int) -> None:
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        #: digest -> page id, in LRU order (oldest first)
+        self._entries: "OrderedDict[bytes, int]" = OrderedDict()
+        #: page id -> digest (a page is bound to at most one digest)
+        self._by_page: dict = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @staticmethod
+    def chain(parent_digest: bytes, tokens: np.ndarray) -> bytes:
+        h = hashlib.blake2b(digest_size=16)
+        h.update(parent_digest)
+        h.update(np.ascontiguousarray(tokens, dtype=np.int32).tobytes())
+        return h.digest()
+
+    def match(self, tokens: np.ndarray,
+              max_pages: int) -> Tuple[List[int], bytes]:
+        """Longest cached prefix of ``tokens``: up to ``max_pages`` full
+        pages.  Returns (page ids, digest of the last matched page) —
+        the digest seeds the sequence's indexing cursor so its own new
+        full pages chain onto the shared ones.  Hits are LRU-touched."""
+        ps = self.page_size
+        pages: List[int] = []
+        digest = b""
+        for i in range(min(max_pages, len(tokens) // ps)):
+            d = self.chain(digest, tokens[i * ps:(i + 1) * ps])
+            page = self._entries.get(d)
+            if page is None:
+                break
+            self._entries.move_to_end(d)
+            pages.append(page)
+            digest = d
+        return pages, digest
+
+    def insert(self, digest: bytes, page: int) -> bool:
+        """Index ``page`` under ``digest``.  First writer wins: if the
+        digest is already bound (another sequence committed the same
+        prefix first) the existing entry is kept — the caller's page
+        stays private and is freed with its sequence."""
+        if digest in self._entries:
+            self._entries.move_to_end(digest)
+            return False
+        if page in self._by_page:  # page already bound to another digest
+            return False
+        self._entries[digest] = int(page)
+        self._by_page[int(page)] = digest
+        return True
+
+    def contains_page(self, page: int) -> bool:
+        return int(page) in self._by_page
+
+    def pages(self) -> List[int]:
+        return list(self._by_page)
+
+    def touch_page(self, page: int) -> None:
+        """Refresh a page's LRU recency (e.g. its last sharer just
+        released it — it was in use until now)."""
+        d = self._by_page.get(int(page))
+        if d is not None:
+            self._entries.move_to_end(d)
+
+    def drop_pages(self, pages: Iterable[int]) -> None:
+        """Unindex ``pages`` (preemption offload of privately-held
+        indexed pages; the page itself is the caller's to free)."""
+        for p in pages:
+            d = self._by_page.pop(int(p), None)
+            if d is not None:
+                del self._entries[d]
+
+    def evict(self, num_pages: int,
+              reclaimable: Callable[[int], bool]) -> List[int]:
+        """Unindex up to ``num_pages`` parked pages in LRU order and
+        return their ids (the caller reclaims them into the free list).
+        Entries whose page is still live occupy no extra pool space —
+        they rotate to the recent end (live means in use right now), so
+        repeated pressure calls don't rescan them from the front."""
+        out: List[int] = []
+        if num_pages <= 0:
+            return out
+        for _ in range(len(self._entries)):
+            if len(out) >= num_pages or not self._entries:
+                break
+            d, page = next(iter(self._entries.items()))
+            if reclaimable(page):
+                del self._entries[d]
+                del self._by_page[page]
+                out.append(page)
+            else:
+                self._entries.move_to_end(d)
+        return out
+
+    def clear(self) -> List[int]:
+        """Drop every entry; returns the pages that were indexed (the
+        caller reclaims whichever of them are parked)."""
+        pages = list(self._by_page)
+        self._entries.clear()
+        self._by_page.clear()
+        return pages

@@ -11,7 +11,7 @@ plain Python object.
 from __future__ import annotations
 
 import dataclasses
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
@@ -21,7 +21,8 @@ class SequenceDescriptor:
     uid: int
     #: tokens whose KV is already committed to the cache
     seen_tokens: int = 0
-    #: KV pages owned by this sequence, in order
+    #: KV pages in this sequence's block table, in order — full prefix
+    #: pages may be SHARED with other sequences (allocator refcounts)
     pages: List[int] = dataclasses.field(default_factory=list)
     #: tokens in flight in the current forward (pre_forward..post_forward)
     in_flight_tokens: int = 0
@@ -30,6 +31,15 @@ class SequenceDescriptor:
     #: table slots the blob's pages belonged to (window-evicted slots
     #: stay null through an offload/restore cycle)
     live_slots: List[int] = dataclasses.field(default_factory=list)
+    #: full prompt token ids, registered at admission when prefix
+    #: caching is on — the indexer hashes full prompt pages from these
+    #: (generated tokens are never indexed: their values are only
+    #: host-known at drain time under async scheduling)
+    prompt_tokens: Optional[np.ndarray] = None
+    #: leading full pages already walked by the prefix indexer
+    indexed_pages: int = 0
+    #: cumulative page-hash chain cursor at ``indexed_pages``
+    last_digest: bytes = b""
 
     @property
     def allocated_capacity(self) -> int:

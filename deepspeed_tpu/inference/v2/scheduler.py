@@ -28,6 +28,7 @@ every page position is write-before-read for its next owner).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -47,6 +48,8 @@ class Request:
     prompt_sent: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    #: prefix-cache lookup already performed (exactly once per request)
+    prefix_checked: bool = False
 
     @property
     def prefill_remaining(self) -> int:
@@ -130,6 +133,13 @@ class FastGenScheduler:
         #: one-way latch: a strict engine's sampling lattice, once seen,
         #: stays seen (avoids rescanning the step cache every step)
         self._fused_ready = False
+        #: scheduler-level prefix-caching gate: a serving= override with
+        #: prefix_caching=False must serve the seed full-prefill path
+        #: even on an engine whose cache is populated
+        self._prefix_cfg = bool(getattr(sv, "prefix_caching", False))
+        #: DS_KV_DEBUG=1: run the manager's page-accounting audit after
+        #: every step (cheap O(live pages) host check)
+        self._kv_debug = os.environ.get("DS_KV_DEBUG", "") not in ("", "0")
 
     # -- request lifecycle ---------------------------------------------------
     def submit(self, uid: int, prompt: Sequence[int],
@@ -285,6 +295,13 @@ class FastGenScheduler:
         sequence whose token became host-visible this step (with
         async_scheduling that is the PREVIOUS step's tokens — one-step
         lag)."""
+        out = self._step_impl(on_token)
+        if self._kv_debug:
+            self._engine.state_manager.check_invariants()
+        return out
+
+    def _step_impl(self, on_token: Optional[Callable[[int, int], None]]
+                   ) -> Dict[int, int]:
         serving_counters.record_step()
         self._preempted_this_step = False
 
@@ -331,6 +348,47 @@ class FastGenScheduler:
         def try_prefill(req: Request, is_new: bool) -> bool:
             if adm.tokens_left <= 0 or req.prefill_remaining == 0:
                 return False
+            if is_new and self._prefix_cfg and not req.prefix_checked:
+                # one-shot prefix-cache lookup before first admission:
+                # cached full pages attach to the (created) sequence and
+                # the scheduler only prefills the uncached suffix
+                if self._engine.state_manager.prefix_cache is None:
+                    req.prefix_checked = True   # engine has no cache
+                elif adm.tracked_left >= 1:
+                    state = self._engine.state_manager
+                    was_tracked = state.get_sequence(req.uid) is not None
+                    alloc = state.kv_cache.allocator
+                    parked_before = alloc.parked_pages
+                    hit = self._engine.match_prefix(req.uid, req.prompt)
+                    # only consume the one-shot once the lookup actually
+                    # ran — match_prefix registers the sequence when it
+                    # does (its own tracked-capacity guard can bail
+                    # first, and that request must retry next step)
+                    req.prefix_checked = \
+                        state.get_sequence(req.uid) is not None
+                    if req.prefix_checked and not was_tracked:
+                        # the lookup created a tracked sequence that
+                        # try_admit below won't charge (is_new flips
+                        # False) — charge it here so later requests'
+                        # `tracked_left >= 1` gate stays accurate
+                        adm.tracked_left -= 1
+                    if hit:
+                        req.prompt_sent = hit
+                        # attached pages that were cache-parked counted
+                        # as FREE in this admission's snapshot and are
+                        # now live — charge exactly the parked->live
+                        # transitions (already-live shared pages were
+                        # never in the snapshot's free count, and an
+                        # earlier same-step hit already paid for pages
+                        # it revived)
+                        adm.free_pages -= parked_before - alloc.parked_pages
+            if is_new:
+                # match_prefix tracks the sequence (even on a miss, to
+                # register the prompt for indexing) — admission must see
+                # the engine's view or the tracked-count gate would
+                # double-charge a request that stays pending
+                is_new = (self._engine.state_manager.get_sequence(req.uid)
+                          is None)
             chunk = min(req.prefill_remaining, adm.tokens_left)
             while chunk > 0 and not adm.try_admit(req.uid, chunk, is_new):
                 chunk //= 2  # shrink to fit KV headroom
@@ -341,6 +399,7 @@ class FastGenScheduler:
             tokens.append(piece.astype(np.int32))
             reqs.append(req)
             req.prompt_sent += chunk
+            serving_counters.record_prefill(chunk)
             return True
 
         for req in list(self._running.values()):
@@ -359,11 +418,14 @@ class FastGenScheduler:
             # its pages go to host via the offload hook and it resumes
             # automatically once the pool frees up
             if self._running:
-                # rank by LIVE pages (window eviction leaves null slots
-                # in sd.pages — they free nothing)
+                # rank by OFFLOADABLE pages: window eviction leaves null
+                # slots and prefix-shared pages (refcount > 1) stay
+                # resident through an offload — neither frees anything,
+                # and a no-op preemption would spin run_to_completion
                 def live_pages(u):
-                    sd = self._engine.state_manager.get_sequence(u)
-                    return sum(1 for p in sd.pages if p != 0) if sd else 0
+                    state = self._engine.state_manager
+                    sd = state.get_sequence(u)
+                    return len(state.offloadable_slots(sd)) if sd else 0
                 victim = max(self._running, key=live_pages)
                 if live_pages(victim) > 0:
                     self._engine.offload_sequence(victim)

@@ -335,21 +335,27 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
     One SplitFuse scheduler step lowers into ONE compiled device program
     (mixed prefill chunks + decode rows in a unified ragged layout) that
     also samples on device, so only int32 tokens cross device->host; the
-    scheduler double-buffers steps via a device-side token gather.  Each
-    flag is an escape hatch back to the seed behavior (per-Q-bucket
-    programs, host-side sampling over [n, V] logits, synchronous
-    stepping); ``enabled: false`` flips all three."""
+    scheduler double-buffers steps via a device-side token gather.
+    ``prefix_caching`` adds the automatic prefix cache over the paged KV
+    pool: full prompt pages are ref-count-shared across sequences and
+    retained after flush (LRU-evicted under pool pressure), so a
+    warm-prefix admission only prefills the uncached suffix.  Each flag
+    is an escape hatch back to the seed behavior (per-Q-bucket programs,
+    host-side sampling over [n, V] logits, synchronous stepping, full
+    re-prefill); ``enabled: false`` flips all four."""
     enabled: bool = True
     fused_step: bool = True
     on_device_sampling: bool = True
     async_scheduling: bool = True
+    prefix_caching: bool = True
 
     def to_v2_dict(self) -> Dict[str, Any]:
         """The ``serving_optimization`` dict the inference-v2 config
         consumes (``RaggedInferenceEngineConfig.from_dict``)."""
         return {"enabled": self.enabled, "fused_step": self.fused_step,
                 "on_device_sampling": self.on_device_sampling,
-                "async_scheduling": self.async_scheduling}
+                "async_scheduling": self.async_scheduling,
+                "prefix_caching": self.prefix_caching}
 
 
 class TPUConfig(DeepSpeedConfigModel):

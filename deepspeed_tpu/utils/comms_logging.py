@@ -51,6 +51,14 @@ class ServingCounters:
         self.h2d_bytes = 0           # batch/sampling arrays fed to programs
         self.d2h_bytes = 0           # bytes actually synced to host
         self.logits_exposed_bytes = 0  # [n, V] buffers returned by put()
+        # prefix cache (ISSUE 3): prompt tokens offered for matching,
+        # tokens served from cached pages, pages LRU-evicted under pool
+        # pressure, and prompt tokens actually prefilled (drops by the
+        # hit fraction when the cache is warm)
+        self.prefix_lookup_tokens = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_evicted_pages = 0
+        self.prefill_tokens = 0
 
     def record_step(self) -> None:
         self.steps += 1
@@ -68,6 +76,17 @@ class ServingCounters:
     def record_logits_exposed(self, nbytes: int) -> None:
         self.logits_exposed_bytes += int(nbytes)
 
+    def record_prefix_lookup(self, lookup_tokens: int,
+                             hit_tokens: int) -> None:
+        self.prefix_lookup_tokens += int(lookup_tokens)
+        self.prefix_hit_tokens += int(hit_tokens)
+
+    def record_prefix_evicted(self, num_pages: int) -> None:
+        self.prefix_evicted_pages += int(num_pages)
+
+    def record_prefill(self, num_tokens: int) -> None:
+        self.prefill_tokens += int(num_tokens)
+
     def snapshot(self) -> Dict[str, Any]:
         steps = max(self.steps, 1)
         return {
@@ -78,6 +97,13 @@ class ServingCounters:
             "d2h_bytes_per_step": self.d2h_bytes // steps,
             "logits_exposed_bytes_per_step":
                 self.logits_exposed_bytes // steps,
+            "prefix_lookup_tokens": self.prefix_lookup_tokens,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_hit_rate": round(
+                self.prefix_hit_tokens / self.prefix_lookup_tokens, 4)
+                if self.prefix_lookup_tokens else 0.0,
+            "prefix_evicted_pages": self.prefix_evicted_pages,
+            "prefill_tokens": self.prefill_tokens,
         }
 
 

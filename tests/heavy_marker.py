@@ -44,6 +44,8 @@ SLOW_TESTS = frozenset([
 ])
 
 HEAVY_TESTS = frozenset([
+    "tests/test_prefix_cache.py::TestServingParity::test_parity_under_preemption",  # 11.5s, small-pool engine build (newly added)
+    "tests/test_prefix_cache.py::TestServingParity::test_parity_sliding_window_model",  # 4.0s, windowed engine build (newly added)
     "tests/test_autotuning.py::test_end_to_end_tune_picks_best",  # 7.01s
     "tests/test_checkpoint.py::TestHFImport::test_build_hf_engine_generates",  # 7.78s
     "tests/test_checkpoint.py::TestHFImport::test_llama_logits_parity",  # 15.90s

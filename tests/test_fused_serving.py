@@ -24,6 +24,14 @@ from deepspeed_tpu.utils.comms_logging import serving_counters
 from flax.core import meta
 
 
+@pytest.fixture(autouse=True)
+def _kv_debug(monkeypatch):
+    """DS_KV_DEBUG=1 (ISSUE 3 CI satellite): every FastGenScheduler
+    built here audits the KV page-accounting invariant after every step,
+    so scheduler changes can't silently leak or double-use pages."""
+    monkeypatch.setenv("DS_KV_DEBUG", "1")
+
+
 SPLIT = ServingOptimizationConfig(fused_step=False,
                                   on_device_sampling=False,
                                   async_scheduling=False)

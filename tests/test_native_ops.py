@@ -184,6 +184,57 @@ def test_aio_missing_file_errors(tmp_path):
     h.close()
 
 
+def test_aio_destroy_with_inflight_wakes_waiters(tmp_path):
+    """ADVICE r5: ~AioHandle used to clear active_ before joining, so a
+    thread blocked in wait_all() during destruction hung forever.  Now
+    destruction marks inflight requests done with a cancellation error
+    and notifies — the waiter must return promptly either way (requests
+    may also legitimately complete before the destroy lands)."""
+    import threading
+    import time
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(num_threads=1, block_size=1 << 12, queue_depth=2)
+    big = np.zeros(4 << 20, np.uint8)
+    reqs = [h.pwrite(big, str(tmp_path / f"c{i}.bin")) for i in range(4)]
+    # one blocking wait on the LAST request (4096 striped parts queue
+    # ahead of it on the single worker), entered BEFORE destroy — the
+    # scenario the fix addresses; the raw handle is captured because
+    # close() clears the wrapper's copy (the C ABI also null-guards)
+    lib, raw = h._lib, h._handle
+    finished = threading.Event()
+
+    def waiter():
+        lib.ds_aio_wait(raw, reqs[-1])  # bytes moved or -ECANCELED
+        finished.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.02)  # let the waiter block inside ds_aio_wait
+    h.close()
+    assert finished.wait(timeout=30), \
+        "wait hung across handle destruction"
+    t.join(timeout=5)
+
+
+def test_aio_depth_capped_request_does_not_block_later_ones(tmp_path):
+    """claimable() scans past a depth-capped front request instead of
+    head-of-line blocking: with queue_depth=1 and 2 workers, two striped
+    requests must both make progress and complete correctly."""
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(num_threads=2, block_size=1 << 12, queue_depth=1)
+    a = (np.arange(1 << 16) % 251).astype(np.uint8)
+    b = a[::-1].copy()
+    ra = h.pwrite(a, str(tmp_path / "a.bin"))
+    rb = h.pwrite(b, str(tmp_path / "b.bin"))
+    assert h.wait(ra) == a.nbytes and h.wait(rb) == b.nbytes
+    oa, ob = np.zeros_like(a), np.zeros_like(b)
+    h.wait(h.pread(oa, str(tmp_path / "a.bin")))
+    h.wait(h.pread(ob, str(tmp_path / "b.bin")))
+    np.testing.assert_array_equal(oa, a)
+    np.testing.assert_array_equal(ob, b)
+    h.close()
+
+
 def test_aio_striped_large_request_and_knobs(tmp_path):
     """Reference aio config surface: block_size striping across threads,
     queue_depth backpressure, O_DIRECT request with buffered fallback.

@@ -1,0 +1,513 @@
+"""Prefix-cached paged KV: ref-counted, copy-on-write page sharing (ISSUE 3).
+
+Covers the tentpole legs — ref-counted allocator with double-free
+detection, the chained-hash prefix index, shared-page-aware manager
+lifecycle (flush retention, preemption offload, window eviction, LRU
+eviction under pressure) — plus the acceptance claims: bit-parity of
+caching on vs off across fused and split serving paths (warm cache,
+shared system prompt, >= 3 sequences, preemption, sliding window), the
+prefill-token drop by the hit fraction, and the ``DS_KV_DEBUG=1``
+page-accounting invariant after every scheduler step (enabled here via
+the autouse fixture; randomized schedules stress it at manager level).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (
+    FastGenScheduler, InferenceEngineV2, KVCacheConfig,
+    RaggedInferenceEngineConfig, RaggedInferenceModel, SamplingParams,
+    ServingOptimizationConfig, StateManagerConfig)
+from deepspeed_tpu.inference.v2.ragged import (
+    BlockedAllocator, PrefixCache, StateManager)
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+from deepspeed_tpu.utils.comms_logging import serving_counters
+from flax.core import meta
+
+
+@pytest.fixture(autouse=True)
+def _kv_debug(monkeypatch):
+    """Every scheduler built in this module audits page accounting after
+    every step (the CI satellite: DS_KV_DEBUG=1 in tier-1 serving
+    tests)."""
+    monkeypatch.setenv("DS_KV_DEBUG", "1")
+
+
+#: caching disabled, everything else default (fused+async)
+OFF = ServingOptimizationConfig(prefix_caching=False)
+#: seed split path with / without caching
+SPLIT_ON = ServingOptimizationConfig(
+    fused_step=False, on_device_sampling=False, async_scheduling=False,
+    prefix_caching=True)
+SPLIT_OFF = dataclasses.replace(SPLIT_ON, prefix_caching=False)
+
+PAGE = 16
+
+
+def _mk_engine(num_pages=64, max_batch=256, max_seqs=8, window=None):
+    kw = {"sliding_window": window} if window else {}
+    model_def = LlamaForCausalLM("debug", max_seq_len=256,
+                                 dtype=jnp.float32, **kw)
+    params = meta.unbox(model_def.init_params(jax.random.key(0)))
+    cfg = model_def.cfg
+    kv_cfg = KVCacheConfig(num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
+                           head_dim=cfg.dims_per_head, page_size=PAGE,
+                           num_pages=num_pages, dtype=jnp.float32)
+    model = RaggedInferenceModel(cfg, params, kv_config=kv_cfg)
+    return InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        state_manager=StateManagerConfig(
+            max_tracked_sequences=max_seqs,
+            max_ragged_sequence_count=max_seqs,
+            max_ragged_batch_size=max_batch)))
+
+
+@pytest.fixture(scope="module")
+def eng():
+    return _mk_engine()
+
+
+def _run(eng, prompts, uids, serving=None, max_new=6, budget=None):
+    sched = FastGenScheduler(eng, token_budget=budget, serving=serving)
+    sp = SamplingParams(max_new_tokens=max_new, temperature=0.0)
+    for uid, p in zip(uids, prompts):
+        sched.submit(uid, p, sp)
+    res = sched.run_to_completion()
+    return [res[u] for u in uids]
+
+
+def _shared_prompts(rng, n=3, prefix_tokens=40, tail=7):
+    shared = rng.integers(0, 128, prefix_tokens).tolist()
+    return [shared + rng.integers(0, 128, tail + i).tolist()
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# satellite: allocator double-free / refcount-underflow guards
+# ---------------------------------------------------------------------------
+
+class TestRefcountedAllocator:
+    def test_double_free_raises(self):
+        a = BlockedAllocator(8)
+        p = a.allocate(2)
+        a.free(p)
+        with pytest.raises(ValueError, match="double free"):
+            a.free([int(p[0])])
+
+    def test_free_of_never_allocated_raises(self):
+        a = BlockedAllocator(8)
+        with pytest.raises(ValueError, match="double free"):
+            a.free([3])
+
+    def test_share_then_free_per_reference(self):
+        a = BlockedAllocator(4)
+        p = int(a.allocate(1)[0])
+        a.add_ref([p])
+        assert a.ref_count(p) == 2
+        a.free([p])                       # one sharer leaves
+        assert a.free_pages == 3 and a.ref_count(p) == 1
+        a.free([p])                       # last sharer: back to the pool
+        assert a.free_pages == 4
+        with pytest.raises(ValueError, match="double free"):
+            a.free([p])
+
+    def test_add_ref_of_free_page_raises(self):
+        a = BlockedAllocator(4)
+        with pytest.raises(ValueError, match="not allocated"):
+            a.add_ref([2])
+
+    def test_park_reclaim_and_underflow(self):
+        a = BlockedAllocator(4)
+        p = int(a.allocate(1)[0])
+        zeroed = a.decref([p])            # parked, NOT back on the list
+        assert zeroed == [p]
+        assert a.free_pages == 3 and a.parked_pages == 1
+        assert a.is_parked(p)
+        with pytest.raises(ValueError, match="underflow"):
+            a.decref([p])                 # parked page: underflow guard
+        a.add_ref([p])                    # cache hit revives it
+        with pytest.raises(ValueError, match="live"):
+            a.reclaim([p])
+        a.free([p])
+        a2 = a.allocate(4)                # whole pool reallocatable
+        assert a.free_pages == 0 and len(set(a2.tolist())) == 4
+
+    def test_accounting_identity(self):
+        a = BlockedAllocator(6)
+        pages = a.allocate(4)
+        a.decref(pages[:2])               # 2 parked
+        assert a.free_pages + a.live_pages + a.parked_pages == 6
+        assert a.live_pages == 2 and a.parked_pages == 2
+
+
+# ---------------------------------------------------------------------------
+# prefix index: chained hashes, LRU, first-writer-wins
+# ---------------------------------------------------------------------------
+
+class TestPrefixIndex:
+    def test_match_walks_chain_and_stops_at_miss(self):
+        pc = PrefixCache(page_size=4)
+        toks = np.arange(12, dtype=np.int32)
+        d0 = pc.chain(b"", toks[:4])
+        d1 = pc.chain(d0, toks[4:8])
+        pc.insert(d0, 5)
+        pc.insert(d1, 9)
+        pages, digest = pc.match(toks, max_pages=3)
+        assert pages == [5, 9] and digest == d1
+        # same page-2 tokens under a DIFFERENT prefix: no match
+        other = np.concatenate([toks[4:8], toks[4:8]])
+        assert pc.match(other, 2)[0] == []
+
+    def test_first_writer_wins(self):
+        pc = PrefixCache(page_size=2)
+        d = pc.chain(b"", np.array([1, 2]))
+        assert pc.insert(d, 3)
+        assert not pc.insert(d, 7)        # digest taken: page 7 stays private
+        assert pc.match(np.array([1, 2]), 1)[0] == [3]
+
+    def test_lru_eviction_skips_live_pages(self):
+        pc = PrefixCache(page_size=2)
+        digests = []
+        for i, page in enumerate((4, 5, 6)):
+            d = pc.chain(bytes([i]), np.array([i, i]))
+            pc.insert(d, page)
+            digests.append(d)
+        # page 5 is "live": the eviction predicate refuses it
+        got = pc.evict(2, reclaimable=lambda p: p != 5)
+        assert got == [4, 6] and len(pc) == 1
+        assert pc.contains_page(5)
+
+    def test_match_touch_refreshes_recency(self):
+        pc = PrefixCache(page_size=2)
+        a = np.array([1, 1]); b = np.array([2, 2])
+        pc.insert(pc.chain(b"", a), 4)
+        pc.insert(pc.chain(b"", b), 5)
+        pc.match(a, 1)                     # page 4 becomes most recent
+        assert pc.evict(1, lambda p: True) == [5]
+
+
+# ---------------------------------------------------------------------------
+# satellite: randomized manager-level invariant stress (no forwards)
+# ---------------------------------------------------------------------------
+
+def _mk_manager(prefix, num_pages=32, page=4):
+    cfg = KVCacheConfig(num_layers=1, kv_heads=1, head_dim=4,
+                        page_size=page, num_pages=num_pages,
+                        dtype=jnp.float32)
+    return StateManager(cfg, max_tracked_sequences=64,
+                        prefix_caching=prefix)
+
+
+class TestInvariantStress:
+    @pytest.mark.parametrize("prefix", [True, False])
+    def test_randomized_schedule_conserves_pages(self, prefix):
+        """free + live + cached == total after every op of a randomized
+        admit/decode/preempt/restore/flush/window-evict schedule."""
+        rng = np.random.default_rng(7 if prefix else 8)
+        sm = _mk_manager(prefix)
+        total = sm.kv_cache.allocator.total_pages
+        templates = [rng.integers(0, 50, 12), rng.integers(0, 50, 8)]
+        live, offloaded = [], []
+        next_uid = 0
+
+        def commit(sd, n):
+            sd.pre_forward(n)
+            sd.post_forward()
+            sm.index_prefix(sd)
+
+        for _ in range(250):
+            op = rng.random()
+            if op < 0.35 or not (live or offloaded):   # admit
+                uid, next_uid = next_uid, next_uid + 1
+                t = templates[int(rng.integers(len(templates)))]
+                prompt = np.concatenate(
+                    [t, rng.integers(0, 50, int(rng.integers(1, 9)))])
+                sd = sm.get_or_create_sequence(uid)
+                hit = sm.match_prefix(sd, prompt)
+                n_new = len(prompt) - hit
+                if sm.pages_needed(sd, n_new) <= sm.free_pages:
+                    sm.allocate_for(sd, n_new)
+                    commit(sd, n_new)
+                    live.append(uid)
+                else:
+                    sm.flush_sequence(uid)
+            elif op < 0.60 and live:                   # decode one token
+                sd = sm.get_sequence(live[int(rng.integers(len(live)))])
+                if sm.pages_needed(sd, 1) <= sm.free_pages:
+                    sm.allocate_for(sd, 1)
+                    commit(sd, 1)
+            elif op < 0.70 and live:                   # window eviction
+                sd = sm.get_sequence(live[int(rng.integers(len(live)))])
+                sm.evict_window(sd, window=8)
+            elif op < 0.80 and live:                   # preempt
+                uid = live.pop(int(rng.integers(len(live))))
+                sm.offload_sequence(uid)
+                offloaded.append(uid)
+            elif op < 0.90 and offloaded:              # restore
+                uid = offloaded[-1]
+                sd = sm.get_sequence(uid)
+                need = (int(sd.host_blob.shape[1])
+                        if sd.host_blob is not None else 0)
+                if need <= sm.free_pages:
+                    sm.restore_sequence(uid)
+                    live.append(offloaded.pop())
+            else:                                      # flush
+                pool = live if live else offloaded
+                if pool:
+                    uid = pool.pop(int(rng.integers(len(pool))))
+                    sm.flush_sequence(uid)
+            sm.check_invariants()
+            alloc = sm.kv_cache.allocator
+            assert (alloc.free_pages + alloc.live_pages
+                    + alloc.parked_pages) == total
+
+        for uid in live + offloaded:
+            sm.flush_sequence(uid)
+        sm.check_invariants()
+        sm.reset_prefix_cache()
+        assert sm.kv_cache.free_pages == total
+
+    def test_invariant_check_catches_planted_double_use(self):
+        sm = _mk_manager(prefix=True)
+        sd = sm.get_or_create_sequence(0)
+        sm.allocate_for(sd, 4)
+        sd.pre_forward(4), sd.post_forward()
+        other = sm.get_or_create_sequence(1)
+        other.pages = [sd.pages[0]]        # stolen page, no refcount
+        with pytest.raises(RuntimeError, match="refcount|block tables"):
+            sm.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# manager-level sharing semantics
+# ---------------------------------------------------------------------------
+
+class TestManagerSharing:
+    def test_match_attaches_full_pages_only_and_leaves_a_suffix_token(self):
+        sm = _mk_manager(prefix=True, page=4)
+        sd = sm.get_or_create_sequence(0)
+        prompt = np.arange(8, dtype=np.int32)   # exactly 2 full pages
+        assert sm.match_prefix(sd, prompt) == 0  # nothing cached yet
+        sm.allocate_for(sd, 8)
+        sd.pre_forward(8), sd.post_forward()
+        sm.index_prefix(sd)
+        assert len(sm.prefix_cache) == 2         # both full pages indexed
+        # identical prompt: only ONE page attaches — the last page would
+        # leave zero tokens to prefill (the step needs last-token logits)
+        sd2 = sm.get_or_create_sequence(1)
+        assert sm.match_prefix(sd2, prompt) == 4
+        assert sd2.pages == [sd.pages[0]]
+        assert sm.kv_cache.allocator.ref_count(sd.pages[0]) == 2
+        # longer prompt: both full pages attach
+        sd3 = sm.get_or_create_sequence(2)
+        assert sm.match_prefix(sd3, np.arange(10, dtype=np.int32)) == 8
+        assert sd3.pages == sd.pages[:2]
+
+    def test_flush_parks_indexed_pages_and_retains_capacity(self):
+        sm = _mk_manager(prefix=True, page=4, num_pages=8)
+        sd = sm.get_or_create_sequence(0)
+        sm.match_prefix(sd, np.arange(9, dtype=np.int32))
+        sm.allocate_for(sd, 9)
+        sd.pre_forward(9), sd.post_forward()
+        sm.index_prefix(sd)
+        assert sm.kv_cache.free_pages == 5       # 3 pages held
+        sm.flush_sequence(0)
+        alloc = sm.kv_cache.allocator
+        # 2 full prompt pages parked (indexed), partial page reclaimed
+        assert alloc.parked_pages == 2
+        assert sm.free_pages == 8                # parked counts schedulable
+        # pressure: allocating the whole pool LRU-evicts the parked pages
+        serving_counters.reset()
+        sd2 = sm.get_or_create_sequence(1)
+        sm.allocate_for(sd2, 32)
+        assert alloc.parked_pages == 0 and len(sm.prefix_cache) == 0
+        assert serving_counters.prefix_evicted_pages == 2
+
+    def test_offload_skips_shared_pages(self):
+        sm = _mk_manager(prefix=True, page=4)
+        a = sm.get_or_create_sequence(0)
+        prompt = np.arange(12, dtype=np.int32)
+        sm.match_prefix(a, prompt)
+        sm.allocate_for(a, 12)
+        a.pre_forward(12), a.post_forward()
+        sm.index_prefix(a)
+        b = sm.get_or_create_sequence(1)
+        assert sm.match_prefix(b, prompt) == 8   # shares 2 full pages
+        shared = list(b.pages)
+        sm.offload_sequence(0)                   # only the private page moves
+        assert a.pages[:2] == shared             # shared pages stay put
+        assert a.pages[2] == 0 and a.host_blob is not None
+        assert [p for p in shared
+                if sm.kv_cache.allocator.ref_count(p) == 2] == shared
+        sm.restore_sequence(0)
+        assert a.pages[:2] == shared and a.pages[2] != 0
+        sm.check_invariants()
+
+    def test_window_eviction_releases_reference_not_page(self):
+        sm = _mk_manager(prefix=True, page=4)
+        a = sm.get_or_create_sequence(0)
+        prompt = np.arange(12, dtype=np.int32)
+        sm.match_prefix(a, prompt)
+        sm.allocate_for(a, 12)
+        a.pre_forward(12), a.post_forward()
+        sm.index_prefix(a)
+        b = sm.get_or_create_sequence(1)
+        sm.match_prefix(b, prompt)
+        sm.allocate_for(b, 4)                    # own the suffix
+        b.pre_forward(4), b.post_forward()
+        shared0 = a.pages[0]
+        sm.evict_window(b, window=4)             # b drops pages 0..1
+        assert b.pages[0] == 0
+        # a (and the cache) still own the page — not freed
+        assert a.pages[0] == shared0
+        assert sm.kv_cache.allocator.ref_count(shared0) == 1
+        sm.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: bit parity, hit accounting, preemption, sliding window
+# ---------------------------------------------------------------------------
+
+class TestServingParity:
+    def test_warm_parity_fused_and_counters(self, eng):
+        """>= 3 sequences sharing a system prompt: caching off == cold
+        == warm, tokenwise, on the fused+async path; warm prefill drops
+        by exactly the hit tokens."""
+        rng = np.random.default_rng(0)
+        prompts = _shared_prompts(rng)
+        ref = _run(eng, prompts, [0, 1, 2], serving=OFF)
+        eng.reset_prefix_cache()
+        serving_counters.reset()
+        cold = _run(eng, prompts, [10, 11, 12])
+        cold_prefill = serving_counters.prefill_tokens
+        serving_counters.reset()
+        warm = _run(eng, prompts, [20, 21, 22])
+        assert ref == cold == warm
+        # replay: every request hits ALL its own full prompt pages (not
+        # just the shared prefix), capped so >= 1 suffix token remains
+        expect = sum(min(len(p) // PAGE, (len(p) - 1) // PAGE) * PAGE
+                     for p in prompts)
+        assert serving_counters.prefix_hit_tokens == expect
+        assert serving_counters.snapshot()["prefix_hit_rate"] > 0
+        assert serving_counters.prefill_tokens == cold_prefill - expect
+
+    def test_warm_parity_split_path(self, eng):
+        rng = np.random.default_rng(1)
+        prompts = _shared_prompts(rng)
+        ref = _run(eng, prompts, [0, 1, 2], serving=SPLIT_OFF)
+        eng.reset_prefix_cache()
+        cold = _run(eng, prompts, [10, 11, 12], serving=SPLIT_ON)
+        serving_counters.reset()
+        warm = _run(eng, prompts, [20, 21, 22], serving=SPLIT_ON)
+        assert ref == cold == warm
+        assert serving_counters.prefix_hit_tokens > 0
+
+    def test_match_prefix_respects_started_sequences(self, eng):
+        eng.reset_prefix_cache()
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(0, 128, 40).tolist()
+        _run(eng, [prompt], [0])
+        assert eng.match_prefix(99, prompt) == 32
+        assert eng.match_prefix(99, prompt) == 0   # already started
+        eng.flush(99)
+        eng.state_manager.check_invariants()
+
+    def test_parity_under_preemption(self, eng):
+        """Pool too small for the working set: preemption must fire and
+        the output must still equal the big-pool caching-off run."""
+        rng = np.random.default_rng(3)
+        shared = rng.integers(0, 128, 32).tolist()
+        prompts = [shared + rng.integers(0, 128, n).tolist()
+                   for n in (80, 50, 30)]
+        ref = _run(eng, prompts, [0, 1, 2], serving=OFF, max_new=12)
+
+        small = _mk_engine(num_pages=12, max_seqs=4)
+        sched = FastGenScheduler(small)
+        sp = SamplingParams(max_new_tokens=12, temperature=0.0)
+        for uid, p in enumerate(prompts):
+            sched.submit(uid, p, sp)
+        all_reqs = {r.uid: r for r in sched._pending}
+        preempted = False
+        for _ in range(400):
+            if not sched.has_work:
+                break
+            sched.step()
+            preempted = preempted or bool(sched._preempted)
+        assert not sched.has_work, "scheduler did not finish"
+        assert preempted, "pool was large enough — preemption never fired"
+        got = [all_reqs[u].generated for u in (0, 1, 2)]
+        assert got == ref
+
+    def test_warm_hit_charges_admission_snapshot(self):
+        """Regression: a prefix hit converts parked pages to live pages
+        mid-step; the admission budget snapshot (which counted them as
+        free) must be charged, or a cold request admitted later in the
+        SAME step over-commits and the allocator raises mid-forward."""
+        rng = np.random.default_rng(5)
+        small = _mk_engine(num_pages=10, max_seqs=4)
+        warm_prompt = rng.integers(0, 128, 6 * PAGE + 4).tolist()
+        _run(small, [warm_prompt], [0], max_new=2)   # 6 full pages cached
+        assert small.state_manager.kv_cache.allocator.parked_pages >= 6
+        cold_prompt = rng.integers(0, 128, 6 * PAGE + 8).tolist()
+        # same step admits the warm replay (revives 6 parked pages) and
+        # the cold request (needs ~7 fresh): must queue, not raise
+        outs = _run(small, [warm_prompt, cold_prompt], [1, 2], max_new=2)
+        assert all(len(o) == 2 for o in outs)
+        small.state_manager.check_invariants()
+
+    def test_parity_sliding_window_model(self):
+        rng = np.random.default_rng(4)
+        weng = _mk_engine(window=8)
+        shared = rng.integers(0, 128, 32).tolist()
+        prompts = [shared + rng.integers(0, 128, 5 + i).tolist()
+                   for i in range(3)]
+        ref = _run(weng, prompts, [0, 1, 2], serving=OFF, max_new=8)
+        weng.reset_prefix_cache()
+        cold = _run(weng, prompts, [10, 11, 12], max_new=8)
+        serving_counters.reset()
+        warm = _run(weng, prompts, [20, 21, 22], max_new=8)
+        assert ref == cold == warm
+        assert serving_counters.prefix_hit_tokens > 0
+        weng.state_manager.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_v2_escape_hatch(self):
+        cfg = RaggedInferenceEngineConfig.from_dict(
+            {"serving_optimization": {"enabled": False}})
+        assert not cfg.serving.prefix_caching
+        cfg = RaggedInferenceEngineConfig.from_dict(
+            {"serving_optimization": {"prefix_caching": False}})
+        assert not cfg.serving.prefix_caching and cfg.serving.fused_step
+        assert RaggedInferenceEngineConfig.from_dict({}) \
+            .serving.prefix_caching
+
+    def test_runtime_block_flows_to_v2(self):
+        from deepspeed_tpu.runtime.config import load_config
+        rc = load_config(
+            {"serving_optimization": {"prefix_caching": False}})
+        v2 = RaggedInferenceEngineConfig.from_dict(
+            {"serving_optimization": rc.serving_optimization.to_v2_dict()})
+        assert not v2.serving.prefix_caching and v2.serving.fused_step
+
+    def test_counter_snapshot_keys(self):
+        snap = serving_counters.snapshot()
+        for k in ("prefix_lookup_tokens", "prefix_hit_tokens",
+                  "prefix_hit_rate", "prefix_evicted_pages",
+                  "prefill_tokens"):
+            assert k in snap
+
+    def test_engine_without_cache_has_no_prefix_state(self):
+        cfg = KVCacheConfig(num_layers=1, kv_heads=1, head_dim=4,
+                            page_size=4, num_pages=8, dtype=jnp.float32)
+        sm = StateManager(cfg, prefix_caching=False)
+        assert sm.prefix_cache is None
+        sd = sm.get_or_create_sequence(0)
+        assert sm.match_prefix(sd, np.arange(12)) == 0
