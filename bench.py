@@ -314,6 +314,50 @@ def bench_fastgen(jax):
             result["fastgen_prefix_prefill_tokens_cold"] = cold_prefill
             result["fastgen_prefix_prefill_tokens_warm"] = \
                 p_count["prefill_tokens"]
+        if os.environ.get("BENCH_SLO", "1") != "0":
+            # SLO leg (ISSUE 4): replay the headline workload with the
+            # telemetry spine enabled — the new tail-latency keys come
+            # straight from the registry's log-bucketed histograms, not
+            # hand-rolled percentile code.  A separate leg so the
+            # headline timings above stay telemetry-off and comparable
+            # across commits (the enabled overhead is ~us/span, but the
+            # control must be exact).  Its own try: a failure here
+            # (unwritable trace path, replay error) must not discard
+            # the already-computed headline keys above.
+            try:
+                from deepspeed_tpu import telemetry
+                from deepspeed_tpu.telemetry import metrics as tmet
+                for h in (tmet.FASTGEN_TTFT_MS, tmet.FASTGEN_ITL_MS,
+                          tmet.FASTGEN_QUEUE_WAIT_MS, tmet.FASTGEN_STEP_MS):
+                    h.reset()
+                telemetry.get_tracer().clear()
+                # the prefix leg may have bound the ds_kv_* gauges to
+                # its dedicated engine — rebind to the measured one
+                eng._bind_kv_gauges()
+                was_enabled = telemetry.enabled()
+                telemetry.enable()
+                try:
+                    run(range(n_req), serving=main_serving)
+                finally:
+                    telemetry.set_enabled(was_enabled)
+                result["fastgen_ttft_p99_ms"] = round(
+                    tmet.FASTGEN_TTFT_MS.percentile(99), 1)
+                result["fastgen_itl_p50_ms"] = round(
+                    tmet.FASTGEN_ITL_MS.percentile(50), 2)
+                result["fastgen_queue_wait_p50_ms"] = round(
+                    tmet.FASTGEN_QUEUE_WAIT_MS.percentile(50), 1)
+                result["fastgen_step_p99_ms"] = round(
+                    tmet.FASTGEN_STEP_MS.percentile(99), 2)
+                if os.environ.get("BENCH_TRACE", "") not in ("", "0"):
+                    # Chrome-trace artifact of the SLO leg, loadable in
+                    # Perfetto, written alongside the BENCH_*.json line
+                    trace_path = os.environ.get("BENCH_TRACE_PATH",
+                                                "BENCH_trace.json")
+                    telemetry.dump_trace(trace_path)
+                    result["fastgen_trace_path"] = trace_path
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: fastgen SLO leg failed: {e}\n")
+                result["fastgen_slo_error"] = str(e)[:300]
         return result
     except Exception as e:  # noqa: BLE001 — aux leg must not kill the bench
         sys.stderr.write(f"bench: fastgen leg failed: {e}\n")

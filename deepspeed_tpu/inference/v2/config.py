@@ -60,6 +60,23 @@ class ServingOptimizationConfig:
 
 
 @dataclasses.dataclass
+class TelemetryConfig:
+    """Serving-side view of the process-wide telemetry spine
+    (``deepspeed_tpu/telemetry``), mirroring the runtime config's
+    ``telemetry`` block.  ``enabled=None`` inherits the process state
+    (``DS_TELEMETRY`` / ``telemetry.enable()``); ``metrics_port``
+    starts the Prometheus endpoint (0 = off); ``trace_buffer`` resizes
+    the span ring (0 = keep current capacity)."""
+    enabled: Optional[bool] = None
+    metrics_port: int = 0
+    trace_buffer: int = 0
+
+    def apply(self) -> None:
+        from ...telemetry import apply_settings
+        apply_settings(self.enabled, self.metrics_port, self.trace_buffer)
+
+
+@dataclasses.dataclass
 class RaggedInferenceEngineConfig:
     state_manager: StateManagerConfig = dataclasses.field(
         default_factory=StateManagerConfig)
@@ -69,6 +86,8 @@ class RaggedInferenceEngineConfig:
         default_factory=QuantizationConfig)
     serving: ServingOptimizationConfig = dataclasses.field(
         default_factory=ServingOptimizationConfig)
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig)
     tp_size: int = 1
 
     @classmethod
@@ -95,5 +114,8 @@ class RaggedInferenceEngineConfig:
             for k, v in srv.items():
                 if hasattr(cfg.serving, k):
                     setattr(cfg.serving, k, v)
+        for k, v in d.get("telemetry", {}).items():
+            if hasattr(cfg.telemetry, k):
+                setattr(cfg.telemetry, k, v)
         cfg.tp_size = d.get("tensor_parallel", {}).get("tp_size", 1)
         return cfg

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ...telemetry import trace_span
 from ...utils.comms_logging import serving_counters
 from .config import RaggedInferenceEngineConfig
 from .model import RaggedInferenceModel
@@ -85,6 +86,30 @@ class InferenceEngineV2:
             max_tracked_sequences=self._config.state_manager.max_tracked_sequences,
             kv_sharding=model.kv_sharding(),
             prefix_caching=self._config.serving.prefix_caching)
+        self._config.telemetry.apply()
+        self._bind_kv_gauges()
+
+    def _bind_kv_gauges(self) -> None:
+        """Bind the ``ds_kv_*`` page-state gauges to this engine's live
+        allocator (callback gauges: the hot path never writes them; with
+        multiple engines in one process the newest owns the gauges —
+        call this again to point them back at an older engine).  Bound
+        through a weakref so the process-global registry never keeps a
+        discarded engine's pool alive; a dead ref reads as 0."""
+        import weakref
+        from ...telemetry import metrics as tm
+        ref = weakref.ref(self._state.kv_cache.allocator)
+
+        def read(attr):
+            def _read(r=ref, a=attr):
+                alloc = r()
+                return getattr(alloc, a) if alloc is not None else 0
+            return _read
+
+        tm.KV_FREE_PAGES.bind(read("free_pages"))
+        tm.KV_LIVE_PAGES.bind(read("live_pages"))
+        tm.KV_PARKED_PAGES.bind(read("parked_pages"))
+        tm.KV_TOTAL_PAGES.bind(read("total_pages"))
 
     def precompile(self, max_prompt: int, max_concurrency: int = 0,
                    max_new_tokens: int = 256,
@@ -250,18 +275,19 @@ class InferenceEngineV2:
     def _admit_batch(self, batch_uids, batch_tokens, do_checks):
         """Shared put/step preamble: schedulability check + KV
         reservation + in-flight marking.  Returns the descriptors."""
-        if do_checks:
-            res = self.can_schedule(batch_uids,
-                                    [len(t) for t in batch_tokens])
-            if res != SchedulingResult.Success:
-                raise SchedulingError(res)
-        descs = []
-        for uid, toks in zip(batch_uids, batch_tokens):
-            sd = self._state.get_or_create_sequence(uid)
-            self._state.allocate_for(sd, len(toks))
-            sd.pre_forward(len(toks))
-            descs.append(sd)
-        return descs
+        with trace_span("engine.admit"):
+            if do_checks:
+                res = self.can_schedule(batch_uids,
+                                        [len(t) for t in batch_tokens])
+                if res != SchedulingResult.Success:
+                    raise SchedulingError(res)
+            descs = []
+            for uid, toks in zip(batch_uids, batch_tokens):
+                sd = self._state.get_or_create_sequence(uid)
+                self._state.allocate_for(sd, len(toks))
+                sd.pre_forward(len(toks))
+                descs.append(sd)
+            return descs
 
     def _commit_batch(self, descs) -> None:
         """Shared put/step epilogue: commit host bookkeeping (the token
@@ -269,15 +295,17 @@ class InferenceEngineV2:
         here), index newly-full prompt pages into the prefix cache, and
         run sliding-window page eviction (in that order: an indexed page
         the window then releases stays cache-retained)."""
-        window = getattr(self._model.cfg, "sliding_window", None)
-        for sd in descs:
-            sd.post_forward()
-            self._state.index_prefix(sd)
-            if window:
-                # Mistral serving: pages wholly outside the window are
-                # unreachable for every future query — return them to the
-                # pool so live KV is O(window), not O(context)
-                self._state.evict_window(sd, window)
+        with trace_span("engine.commit"):
+            window = getattr(self._model.cfg, "sliding_window", None)
+            for sd in descs:
+                sd.post_forward()
+                self._state.index_prefix(sd)
+                if window:
+                    # Mistral serving: pages wholly outside the window
+                    # are unreachable for every future query — return
+                    # them to the pool so live KV is O(window), not
+                    # O(context)
+                    self._state.evict_window(sd, window)
 
     def _build_batch(self, descs, tokens, h2d_tokens: bool = True):
         """Pack one segment; h2d bytes accrue here, program dispatches
@@ -285,16 +313,17 @@ class InferenceEngineV2:
         ONE program).  ``h2d_tokens=False`` for chained steps, whose
         token ids never leave the device (the placeholder token_ids
         array is not an input of the chained program)."""
-        batch = build_batch(
-            descs, tokens, self._model.kv_config.page_size,
-            fresh_supported=getattr(self._model, "_fresh_attention",
-                                    None) is not None)
-        nbytes = (batch.q_lens.nbytes + batch.start_pos.nbytes
-                  + batch.page_table.nbytes)
-        if h2d_tokens:
-            nbytes += batch.token_ids.nbytes
-        serving_counters.record_h2d(nbytes)
-        return batch
+        with trace_span("engine.build_batch"):
+            batch = build_batch(
+                descs, tokens, self._model.kv_config.page_size,
+                fresh_supported=getattr(self._model, "_fresh_attention",
+                                        None) is not None)
+            nbytes = (batch.q_lens.nbytes + batch.start_pos.nbytes
+                      + batch.page_table.nbytes)
+            if h2d_tokens:
+                nbytes += batch.token_ids.nbytes
+            serving_counters.record_h2d(nbytes)
+            return batch
 
     def put(self, batch_uids: Sequence[int],
             batch_tokens: Sequence[np.ndarray],

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ....telemetry import trace_span
 from ....utils.comms_logging import serving_counters
 from .blocked_allocator import NULL_PAGE
 from .kv_cache import BlockedKVCache, KVCacheConfig
@@ -97,10 +98,11 @@ class StateManager:
         deficit = num_pages - alloc.free_pages
         if deficit <= 0 or self.prefix_cache is None:
             return
-        evicted = self.prefix_cache.evict(deficit, alloc.is_parked)
-        if evicted:
-            alloc.reclaim(evicted)
-            serving_counters.record_prefix_evicted(len(evicted))
+        with trace_span("kv.evict"):
+            evicted = self.prefix_cache.evict(deficit, alloc.is_parked)
+            if evicted:
+                alloc.reclaim(evicted)
+                serving_counters.record_prefix_evicted(len(evicted))
 
     # -- prefix cache -------------------------------------------------------
     def match_prefix(self, sd: SequenceDescriptor,
@@ -119,15 +121,16 @@ class StateManager:
         max_pages = (len(prompt) - 1) // page
         if max_pages <= 0:
             return 0
-        pages, digest = self.prefix_cache.match(prompt, max_pages)
-        if not pages:
-            return 0
-        self.kv_cache.allocator.add_ref(pages)
-        sd.pages = [int(p) for p in pages]
-        sd.seen_tokens = len(pages) * page
-        sd.indexed_pages = len(pages)
-        sd.last_digest = digest
-        return sd.seen_tokens
+        with trace_span("kv.match_prefix"):
+            pages, digest = self.prefix_cache.match(prompt, max_pages)
+            if not pages:
+                return 0
+            self.kv_cache.allocator.add_ref(pages)
+            sd.pages = [int(p) for p in pages]
+            sd.seen_tokens = len(pages) * page
+            sd.indexed_pages = len(pages)
+            sd.last_digest = digest
+            return sd.seen_tokens
 
     def index_prefix(self, sd: SequenceDescriptor) -> None:
         """Index newly-committed FULL prompt pages (called after each
@@ -138,14 +141,18 @@ class StateManager:
             return
         page = self.kv_config.page_size
         full = min(sd.seen_tokens, len(sd.prompt_tokens)) // page
-        for i in range(sd.indexed_pages, full):
-            digest = self.prefix_cache.chain(
-                sd.last_digest, sd.prompt_tokens[i * page:(i + 1) * page])
-            p = sd.pages[i] if i < len(sd.pages) else NULL_PAGE
-            if p != NULL_PAGE:  # window-evicted slots can't be indexed
-                self.prefix_cache.insert(digest, int(p))
-            sd.last_digest = digest
-            sd.indexed_pages = i + 1
+        if full <= sd.indexed_pages:
+            return
+        with trace_span("kv.index_prefix"):
+            for i in range(sd.indexed_pages, full):
+                digest = self.prefix_cache.chain(
+                    sd.last_digest,
+                    sd.prompt_tokens[i * page:(i + 1) * page])
+                p = sd.pages[i] if i < len(sd.pages) else NULL_PAGE
+                if p != NULL_PAGE:  # window-evicted slots can't be indexed
+                    self.prefix_cache.insert(digest, int(p))
+                sd.last_digest = digest
+                sd.indexed_pages = i + 1
 
     def reset_prefix_cache(self) -> None:
         """Drop the whole index and reclaim its parked pages (bench
@@ -172,8 +179,11 @@ class StateManager:
     def flush_sequence(self, uid: int) -> None:
         sd = self._seqs.pop(uid, None)
         if sd is not None:
-            # window eviction leaves null-page placeholders — not ours
-            self._release_pages([p for p in sd.pages if p != NULL_PAGE])
+            with trace_span("kv.flush"):
+                # window eviction leaves null-page placeholders — not
+                # ours
+                self._release_pages(
+                    [p for p in sd.pages if p != NULL_PAGE])
 
     def offload_sequence(self, uid: int) -> None:
         """Preempt: move a sequence's PRIVATE live KV pages to host
@@ -186,6 +196,10 @@ class StateManager:
         sd = self._seqs.get(uid)
         if sd is None or sd.host_blob is not None:
             return  # unknown/flushed uids tolerated like flush_sequence
+        with trace_span("kv.offload"):
+            self._offload_impl(sd)
+
+    def _offload_impl(self, sd: SequenceDescriptor) -> None:
         sd.live_slots = self.offloadable_slots(sd)
         live = [sd.pages[i] for i in sd.live_slots]
         if not live:
@@ -211,12 +225,13 @@ class StateManager:
         sd = self._seqs.get(uid)
         if sd is None or sd.host_blob is None:
             return
-        self.ensure_free(int(sd.host_blob.shape[1]))
-        pages = self.kv_cache.restore_pages(sd.host_blob)
-        for slot, p in zip(sd.live_slots, pages):
-            sd.pages[slot] = int(p)
-        sd.host_blob = None
-        sd.live_slots = []
+        with trace_span("kv.restore"):
+            self.ensure_free(int(sd.host_blob.shape[1]))
+            pages = self.kv_cache.restore_pages(sd.host_blob)
+            for slot, p in zip(sd.live_slots, pages):
+                sd.pages[slot] = int(p)
+            sd.host_blob = None
+            sd.live_slots = []
         # restored pages are private again; if offload unindexed any of
         # them it also disabled this sequence's indexing (broken chain),
         # otherwise the digest chain is intact and indexing continues

@@ -29,11 +29,15 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from ...telemetry import get_tracer, trace_span
+from ...telemetry import metrics as tm
+from ...telemetry.state import state as _telemetry
 from ...utils.comms_logging import serving_counters
 from .engine import InferenceEngineV2
 from .sampling import SamplingParams, sample
@@ -50,6 +54,15 @@ class Request:
     done: bool = False
     #: prefix-cache lookup already performed (exactly once per request)
     prefix_checked: bool = False
+    #: SLO stamps (ISSUE 4, perf_counter seconds; 0.0 = unset/telemetry
+    #: off at submit): submit time, first scheduled admission, and the
+    #: previous host-visible token.  ``slo_gen`` records the telemetry
+    #: generation ``last_token_s`` was taken in, so a stamp from before
+    #: a disabled gap can't observe the gap as one giant ITL sample
+    submit_s: float = 0.0
+    first_sched_s: float = 0.0
+    last_token_s: float = 0.0
+    slo_gen: int = 0
 
     @property
     def prefill_remaining(self) -> int:
@@ -140,13 +153,19 @@ class FastGenScheduler:
         #: DS_KV_DEBUG=1: run the manager's page-accounting audit after
         #: every step (cheap O(live pages) host check)
         self._kv_debug = os.environ.get("DS_KV_DEBUG", "") not in ("", "0")
+        #: telemetry (ISSUE 4): this scheduler's step ordinal for span
+        #: labels (independent of other tracer users in the process)
+        self._step_ordinal = 0
 
     # -- request lifecycle ---------------------------------------------------
     def submit(self, uid: int, prompt: Sequence[int],
                params: Optional[SamplingParams] = None) -> None:
-        self._pending.append(Request(
+        req = Request(
             uid=uid, prompt=np.asarray(prompt, dtype=np.int32),
-            params=params or SamplingParams()))
+            params=params or SamplingParams())
+        if _telemetry.enabled:
+            req.submit_s = time.perf_counter()
+        self._pending.append(req)
 
     @property
     def has_work(self) -> bool:
@@ -196,10 +215,29 @@ class FastGenScheduler:
         self._rng, key = jax.random.split(self._rng)
         return key
 
+    # -- slo: per-request latency stamps (enabled path only) -----------------
+    def _note_token_slo(self, req: Request) -> None:
+        """One host-visible token: first token -> TTFT (submit to now),
+        later tokens -> inter-token latency.  Requests submitted while
+        telemetry was off (``submit_s == 0``) only feed the ITL stream
+        once they have a same-regime reference stamp."""
+        now = time.perf_counter()
+        if len(req.generated) == 1:
+            if req.submit_s:
+                tm.FASTGEN_TTFT_MS.observe((now - req.submit_s) * 1e3)
+        elif req.last_token_s and req.slo_gen == _telemetry.generation:
+            tm.FASTGEN_ITL_MS.observe((now - req.last_token_s) * 1e3)
+        req.last_token_s = now
+        req.slo_gen = _telemetry.generation
+
     # -- drain: sync a dispatched step's tokens ------------------------------
     def _drain(self, on_token) -> Dict[int, int]:
         if self._inflight is None:
             return {}
+        with trace_span("fastgen.drain"):
+            return self._drain_impl(on_token)
+
+    def _drain_impl(self, on_token) -> Dict[int, int]:
         inf, self._inflight = self._inflight, None
         toks = np.asarray(inf.tokens_dev)   # the ONLY d2h: [S] int32
         serving_counters.record_d2h(toks.nbytes)
@@ -212,6 +250,8 @@ class FastGenScheduler:
                 continue
             tok = int(toks[row])
             req.generated.append(tok)
+            if _telemetry.enabled:
+                self._note_token_slo(req)
             out[uid] = tok
             if on_token is not None:
                 on_token(uid, tok)
@@ -295,10 +335,55 @@ class FastGenScheduler:
         sequence whose token became host-visible this step (with
         async_scheduling that is the PREVIOUS step's tokens — one-step
         lag)."""
-        out = self._step_impl(on_token)
+        if _telemetry.enabled:
+            # spans from this step (and everything nested under it) are
+            # labelled with THIS scheduler's own step ordinal — not
+            # derived from the tracer's current label, which a training
+            # engine sharing the process (hybrid RLHF) also writes
+            self._step_ordinal += 1
+            get_tracer().set_step(self._step_ordinal)
+            t0 = time.perf_counter()
+            with trace_span("fastgen.step"):
+                out = self._step_impl(on_token)
+            tm.FASTGEN_STEP_MS.observe((time.perf_counter() - t0) * 1e3)
+        else:
+            out = self._step_impl(on_token)
         if self._kv_debug:
             self._engine.state_manager.check_invariants()
         return out
+
+    def _match_prefix_once(self, req: Request, adm: _Admission) -> None:
+        """One-shot prefix-cache lookup before first admission: cached
+        full pages attach to the (created) sequence and the scheduler
+        only prefills the uncached suffix."""
+        if self._engine.state_manager.prefix_cache is None:
+            req.prefix_checked = True   # engine has no cache
+            return
+        if adm.tracked_left < 1:
+            return
+        state = self._engine.state_manager
+        was_tracked = state.get_sequence(req.uid) is not None
+        alloc = state.kv_cache.allocator
+        parked_before = alloc.parked_pages
+        hit = self._engine.match_prefix(req.uid, req.prompt)
+        # only consume the one-shot once the lookup actually ran —
+        # match_prefix registers the sequence when it does (its own
+        # tracked-capacity guard can bail first, and that request must
+        # retry next step)
+        req.prefix_checked = state.get_sequence(req.uid) is not None
+        if req.prefix_checked and not was_tracked:
+            # the lookup created a tracked sequence that try_admit below
+            # won't charge (is_new flips False) — charge it here so
+            # later requests' `tracked_left >= 1` gate stays accurate
+            adm.tracked_left -= 1
+        if hit:
+            req.prompt_sent = hit
+            # attached pages that were cache-parked counted as FREE in
+            # this admission's snapshot and are now live — charge
+            # exactly the parked->live transitions (already-live shared
+            # pages were never in the snapshot's free count, and an
+            # earlier same-step hit already paid for pages it revived)
+            adm.free_pages -= parked_before - alloc.parked_pages
 
     def _step_impl(self, on_token: Optional[Callable[[int, int], None]]
                    ) -> Dict[int, int]:
@@ -309,107 +394,91 @@ class FastGenScheduler:
         if chain is not None:
             # dispatch k+1 FIRST, then drain k: the host sync below
             # overlaps the device executing the new step
-            new_inflight = self._dispatch_chain(chain)
+            with trace_span("fastgen.dispatch.chain"):
+                new_inflight = self._dispatch_chain(chain)
             out = self._drain(on_token)
             self._inflight = new_inflight
             return out
 
         out_prev = self._drain(on_token)
 
-        # resume preempted sequences first when the pool has room again
-        # (restore cost = their live page count, plus decode headroom)
-        for uid in list(self._preempted):
-            sd = self._engine.state_manager.get_sequence(uid)
-            if sd is None:  # flushed/cancelled while preempted
-                self._preempted.pop(uid)
-                continue
-            need = sd.host_blob.shape[1] if sd.host_blob is not None else 0
-            if self._engine.free_blocks >= need + 1:
-                self._engine.restore_sequence(uid)
-                self._running[uid] = self._preempted.pop(uid)
+        with trace_span("fastgen.admission"):
+            # resume preempted sequences first when the pool has room
+            # again (restore cost = their live page count, plus decode
+            # headroom)
+            for uid in list(self._preempted):
+                sd = self._engine.state_manager.get_sequence(uid)
+                if sd is None:  # flushed/cancelled while preempted
+                    self._preempted.pop(uid)
+                    continue
+                need = (sd.host_blob.shape[1]
+                        if sd.host_blob is not None else 0)
+                if self._engine.free_blocks >= need + 1:
+                    self._engine.restore_sequence(uid)
+                    self._running[uid] = self._preempted.pop(uid)
 
-        adm = _Admission(self._engine, self._budget)
-        uids: List[int] = []
-        tokens: List[np.ndarray] = []
-        reqs: List[Request] = []
+            adm = _Admission(self._engine, self._budget)
+            uids: List[int] = []
+            tokens: List[np.ndarray] = []
+            reqs: List[Request] = []
 
-        # 1. all running decodes (one token each)
-        for uid, req in self._running.items():
-            if req.prefill_remaining > 0:
-                continue  # mid-prefill requests handled below
-            if not adm.try_admit(uid, 1, is_new=False):
-                continue
-            last = req.generated[-1] if req.generated else int(req.prompt[-1])
-            uids.append(uid)
-            tokens.append(np.array([last], dtype=np.int32))
-            reqs.append(req)
+            # 1. all running decodes (one token each)
+            for uid, req in self._running.items():
+                if req.prefill_remaining > 0:
+                    continue  # mid-prefill requests handled below
+                if not adm.try_admit(uid, 1, is_new=False):
+                    continue
+                last = (req.generated[-1] if req.generated
+                        else int(req.prompt[-1]))
+                uids.append(uid)
+                tokens.append(np.array([last], dtype=np.int32))
+                reqs.append(req)
 
-        # 2. continue partial prefills, then admit pending, chunked to budget
-        def try_prefill(req: Request, is_new: bool) -> bool:
-            if adm.tokens_left <= 0 or req.prefill_remaining == 0:
-                return False
-            if is_new and self._prefix_cfg and not req.prefix_checked:
-                # one-shot prefix-cache lookup before first admission:
-                # cached full pages attach to the (created) sequence and
-                # the scheduler only prefills the uncached suffix
-                if self._engine.state_manager.prefix_cache is None:
-                    req.prefix_checked = True   # engine has no cache
-                elif adm.tracked_left >= 1:
-                    state = self._engine.state_manager
-                    was_tracked = state.get_sequence(req.uid) is not None
-                    alloc = state.kv_cache.allocator
-                    parked_before = alloc.parked_pages
-                    hit = self._engine.match_prefix(req.uid, req.prompt)
-                    # only consume the one-shot once the lookup actually
-                    # ran — match_prefix registers the sequence when it
-                    # does (its own tracked-capacity guard can bail
-                    # first, and that request must retry next step)
-                    req.prefix_checked = \
-                        state.get_sequence(req.uid) is not None
-                    if req.prefix_checked and not was_tracked:
-                        # the lookup created a tracked sequence that
-                        # try_admit below won't charge (is_new flips
-                        # False) — charge it here so later requests'
-                        # `tracked_left >= 1` gate stays accurate
-                        adm.tracked_left -= 1
-                    if hit:
-                        req.prompt_sent = hit
-                        # attached pages that were cache-parked counted
-                        # as FREE in this admission's snapshot and are
-                        # now live — charge exactly the parked->live
-                        # transitions (already-live shared pages were
-                        # never in the snapshot's free count, and an
-                        # earlier same-step hit already paid for pages
-                        # it revived)
-                        adm.free_pages -= parked_before - alloc.parked_pages
-            if is_new:
-                # match_prefix tracks the sequence (even on a miss, to
-                # register the prompt for indexing) — admission must see
-                # the engine's view or the tracked-count gate would
-                # double-charge a request that stays pending
-                is_new = (self._engine.state_manager.get_sequence(req.uid)
-                          is None)
-            chunk = min(req.prefill_remaining, adm.tokens_left)
-            while chunk > 0 and not adm.try_admit(req.uid, chunk, is_new):
-                chunk //= 2  # shrink to fit KV headroom
-            if chunk == 0:
-                return False
-            piece = req.prompt[req.prompt_sent:req.prompt_sent + chunk]
-            uids.append(req.uid)
-            tokens.append(piece.astype(np.int32))
-            reqs.append(req)
-            req.prompt_sent += chunk
-            serving_counters.record_prefill(chunk)
-            return True
+            # 2. continue partial prefills, then admit pending, chunked
+            # to budget
+            def try_prefill(req: Request, is_new: bool) -> bool:
+                if adm.tokens_left <= 0 or req.prefill_remaining == 0:
+                    return False
+                if is_new and self._prefix_cfg and not req.prefix_checked:
+                    with trace_span("fastgen.prefix_match"):
+                        self._match_prefix_once(req, adm)
+                if is_new:
+                    # match_prefix tracks the sequence (even on a miss,
+                    # to register the prompt for indexing) — admission
+                    # must see the engine's view or the tracked-count
+                    # gate would double-charge a request that stays
+                    # pending
+                    is_new = (self._engine.state_manager
+                              .get_sequence(req.uid) is None)
+                chunk = min(req.prefill_remaining, adm.tokens_left)
+                while chunk > 0 and not adm.try_admit(req.uid, chunk,
+                                                      is_new):
+                    chunk //= 2  # shrink to fit KV headroom
+                if chunk == 0:
+                    return False
+                piece = req.prompt[req.prompt_sent:req.prompt_sent + chunk]
+                uids.append(req.uid)
+                tokens.append(piece.astype(np.int32))
+                reqs.append(req)
+                req.prompt_sent += chunk
+                serving_counters.record_prefill(chunk)
+                if _telemetry.enabled and req.first_sched_s == 0.0:
+                    # first scheduled admission: close the queue-wait
+                    # window opened at submit
+                    req.first_sched_s = time.perf_counter()
+                    if req.submit_s:
+                        tm.FASTGEN_QUEUE_WAIT_MS.observe(
+                            (req.first_sched_s - req.submit_s) * 1e3)
+                return True
 
-        for req in list(self._running.values()):
-            try_prefill(req, is_new=False)
-        while self._pending and adm.tokens_left > 0:
-            req = self._pending[0]
-            if not try_prefill(req, is_new=True):
-                break
-            self._pending.pop(0)
-            self._running[req.uid] = req
+            for req in list(self._running.values()):
+                try_prefill(req, is_new=False)
+            while self._pending and adm.tokens_left > 0:
+                req = self._pending[0]
+                if not try_prefill(req, is_new=True):
+                    break
+                self._pending.pop(0)
+                self._running[req.uid] = req
 
         self.last_step_scheduled = len(uids)
         if not uids:
@@ -428,7 +497,8 @@ class FastGenScheduler:
                     return len(state.offloadable_slots(sd)) if sd else 0
                 victim = max(self._running, key=live_pages)
                 if live_pages(victim) > 0:
-                    self._engine.offload_sequence(victim)
+                    with trace_span("fastgen.preempt"):
+                        self._engine.offload_sequence(victim)
                     self._preempted[victim] = self._running.pop(victim)
                     self._preempted_this_step = True
             return out_prev
@@ -463,9 +533,10 @@ class FastGenScheduler:
             # greedy_only above uses the same sampled-rows-only rule
             row_params = [r.params if r.prefill_remaining == 0
                           else SamplingParams() for r in reqs]
-            toks, rowmap = self._engine.step_sample(
-                uids, tokens, row_params, self._next_key(greedy_only),
-                do_checks=False)
+            with trace_span("fastgen.dispatch.fused"):
+                toks, rowmap = self._engine.step_sample(
+                    uids, tokens, row_params, self._next_key(greedy_only),
+                    do_checks=False)
             self._inflight = _Inflight(
                 tokens_dev=toks,
                 rows=[(uids[i], rowmap[i], reqs[i])
@@ -483,25 +554,28 @@ class FastGenScheduler:
         put_fused = self._serving.fused_step and not strict_mixed
         if put_fused and strict:
             put_fused = self._strict_key_ok(uids, tokens, ())
-        logits = self._engine.put(uids, tokens, do_checks=False,
-                                  fused=put_fused)
-        groups: Dict[tuple, List[int]] = {}
-        for i in sampled_rows:
-            groups.setdefault(_group_key(reqs[i].params), []).append(i)
-        new_tokens: Dict[int, int] = {}
-        for (temp, top_k, top_p), idxs in groups.items():
-            key = self._next_key(greedy_only=temp <= 0.0)
-            toks = np.asarray(sample(logits[np.asarray(idxs)], key,
-                                     temperature=temp, top_k=top_k,
-                                     top_p=top_p))
-            serving_counters.record_d2h(toks.nbytes)
-            for i, t in zip(idxs, toks):
-                new_tokens[i] = int(t)
+        with trace_span("fastgen.dispatch.split"):
+            logits = self._engine.put(uids, tokens, do_checks=False,
+                                      fused=put_fused)
+            groups: Dict[tuple, List[int]] = {}
+            for i in sampled_rows:
+                groups.setdefault(_group_key(reqs[i].params), []).append(i)
+            new_tokens: Dict[int, int] = {}
+            for (temp, top_k, top_p), idxs in groups.items():
+                key = self._next_key(greedy_only=temp <= 0.0)
+                toks = np.asarray(sample(logits[np.asarray(idxs)], key,
+                                         temperature=temp, top_k=top_k,
+                                         top_p=top_p))
+                serving_counters.record_d2h(toks.nbytes)
+                for i, t in zip(idxs, toks):
+                    new_tokens[i] = int(t)
 
         out = dict(out_prev)
         for i, tok in new_tokens.items():
             req = reqs[i]
             req.generated.append(tok)
+            if _telemetry.enabled:
+                self._note_token_slo(req)
             out[req.uid] = tok
             if on_token is not None:
                 on_token(req.uid, tok)

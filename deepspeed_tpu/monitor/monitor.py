@@ -48,21 +48,47 @@ class CSVMonitor(Monitor):
         super().__init__(config)
         self.output_path = config.output_path or "./csv_monitor"
         self.job_name = config.job_name
+        # tag -> (file handle, csv.writer): one open append handle per
+        # tag for the life of the monitor (an open+close per EVENT was
+        # the dominant cost of a steps_per_print flush), flushed once
+        # per write_events batch
         self._files = {}
+
+    def _writer(self, tag: str):
+        entry = self._files.get(tag)
+        if entry is None:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 tag.replace("/", "_") + ".csv")
+            os.makedirs(os.path.dirname(fname), exist_ok=True)
+            new = not os.path.exists(fname) or os.path.getsize(fname) == 0
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", tag])
+            entry = self._files[tag] = (f, w)
+        return entry
 
     def write_events(self, event_list: List[Event]) -> None:
         if not self.enabled or jax.process_index() != 0:
             return
+        touched = []
         for tag, value, step in event_list:
-            fname = os.path.join(self.output_path, self.job_name,
-                                 tag.replace("/", "_") + ".csv")
-            os.makedirs(os.path.dirname(fname), exist_ok=True)
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", tag])
-                w.writerow([int(step), float(value)])
+            f, w = self._writer(tag)
+            w.writerow([int(step), float(value)])
+            touched.append(f)
+        for f in touched:
+            f.flush()
+
+    def close(self) -> None:
+        for f, _ in self._files.values():
+            f.close()
+        self._files.clear()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class WandbMonitor(Monitor):
@@ -136,3 +162,33 @@ class MonitorMaster(Monitor):
         for m in self.monitors:
             if m.enabled:
                 m.write_events(event_list)
+
+    def write_registry_snapshot(self, step: int) -> None:
+        """Publish the telemetry registry's ``snapshot()`` through every
+        enabled writer under ``Telemetry/<metric>`` tags — the SAME
+        names (and values) the /metrics endpoint and bench.py read, so
+        monitor artifacts stop being a fifth metrics namespace.  Called
+        by the engine at the ``steps_per_print`` cadence.  Metrics that
+        have never recorded anything (zero counters, never-observed
+        histograms, unbound/unset gauges) are skipped — a training-only
+        process does not fan out ~40 all-zero serving series per flush."""
+        if not self.enabled:
+            return
+        from ..telemetry import Counter, Gauge, Histogram, get_registry
+        events: List[Event] = []
+        for name, m in sorted(get_registry().all_metrics().items()):
+            if isinstance(m, Histogram):
+                if m.count == 0:
+                    continue
+                events += [(f"Telemetry/{name}_p50", m.percentile(50), step),
+                           (f"Telemetry/{name}_p90", m.percentile(90), step),
+                           (f"Telemetry/{name}_p99", m.percentile(99), step),
+                           (f"Telemetry/{name}_count", m.count, step),
+                           (f"Telemetry/{name}_mean", m.mean, step)]
+            elif isinstance(m, Counter):
+                if m.value:
+                    events.append((f"Telemetry/{name}", m.value, step))
+            elif isinstance(m, Gauge):
+                if m.touched:
+                    events.append((f"Telemetry/{name}", m.value, step))
+        self.write_events(events)

@@ -222,6 +222,25 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """``telemetry`` section — the process-wide observability spine
+    (``deepspeed_tpu/telemetry``): metrics registry + span tracer + SLO
+    histograms.  ``enabled: null`` (default) inherits the process state
+    (``DS_TELEMETRY`` env / ``telemetry.enable()``); an explicit bool
+    wins.  ``metrics_port`` starts the Prometheus endpoint
+    (0 = off, same as ``DS_METRICS_PORT``); ``trace_buffer`` resizes the
+    span ring buffer (0 = keep the current capacity)."""
+    enabled: Optional[bool] = None
+    metrics_port: int = 0
+    trace_buffer: int = 0
+
+    def apply(self) -> None:
+        """Push this block into the process-wide telemetry state (shared
+        by the runtime engine and the inference-v2 engine)."""
+        from ..telemetry import apply_settings
+        apply_settings(self.enabled, self.metrics_port, self.trace_buffer)
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation: str = "Warn"  # Ignore|Warn|Fail
     load_universal: bool = False
@@ -413,6 +432,7 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     comet: MonitorConfigItem = Field(default_factory=MonitorConfigItem)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)

@@ -47,6 +47,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import comm as dist
 from ..parallel.topology import (BATCH_AXES, MeshTopology, TopologyConfig)
+from ..telemetry import get_tracer, trace_span
+from ..telemetry.state import state as telemetry_state
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER,
@@ -230,6 +232,7 @@ class DeepSpeedEngine:
         self._eval_step = self._build_eval_step()
 
         # -- io/observability ---------------------------------------------
+        self.config.telemetry.apply()
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
@@ -915,19 +918,30 @@ class DeepSpeedEngine:
                 "eval-regime scoring)")
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
-        with self.topology.mesh:
-            batch = self._place_batch(batch, microbatched=True)
+        if telemetry_state.enabled:
+            get_tracer().set_step(self.global_steps)
+        with trace_span("train.batch"), self.topology.mesh:
+            with trace_span("train.place_batch"):
+                batch = self._place_batch(batch, microbatched=True)
             self._maybe_profile_flops(batch)
-            self.state, metrics, off_grads = self._train_step(
-                self.state, batch, self._next_rng())
+            # the fused step is ONE compiled program (fwd + bwd +
+            # collective flush + optimizer); the float() sync below is
+            # where the host blocks on it, so train.step covers dispatch
+            # + device execution.  Per-phase device attribution comes
+            # from the jax profiler (the span's TraceAnnotation lines
+            # host spans up with the device timeline).
+            with trace_span("train.step"):
+                self.state, metrics, off_grads = self._train_step(
+                    self.state, batch, self._next_rng())
+                loss = float(metrics["loss"])
             # overflow skip exists only under fp16 loss scaling — the
             # device path updates unconditionally in bf16 mode, and the
             # host must mirror it exactly or the two halves desync
             if self.offload is not None and not (
                     self._fp16_enabled and int(metrics["overflow"])):
-                self._apply_offload_step(off_grads,
-                                         float(metrics["applied_lr"]))
-        loss = float(metrics["loss"])
+                with trace_span("train.offload_step"):
+                    self._apply_offload_step(off_grads,
+                                             float(metrics["applied_lr"]))
         from ..tools.tensor_logger import record_active
         # iteration stays the caller's (log_iteration/set_iteration)
         record_active("model_inputs", "batch", batch)
@@ -946,6 +960,11 @@ class DeepSpeedEngine:
             self.monitor.write_events([
                 ("Train/Samples/train_loss", loss, self.global_samples),
                 ("Train/Samples/lr", float(metrics["lr"]), self.global_samples)])
+            if self.global_steps % self.config.steps_per_print == 0:
+                # full telemetry-registry snapshot rides the monitor fan-
+                # out at the print cadence (one source of truth: the same
+                # names the /metrics endpoint and bench.py read)
+                self.monitor.write_registry_snapshot(self.global_samples)
         if self.config.wall_clock_breakdown and \
                 self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([TRAIN_BATCH_TIMER])
@@ -968,8 +987,7 @@ class DeepSpeedEngine:
             cost = cost[0] if cost else {}
         prof._cost = {"flops": float(cost.get("flops", 0.0)),
                       "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
-        prof._duration = self.tput_timer.avg_step_time() \
-            if hasattr(self.tput_timer, "avg_step_time") else 0.0
+        prof._duration = self.tput_timer.avg_step_time()
         prof.print_model_profile(
             profile_step=self.global_steps,
             module_depth=fp_cfg.module_depth,
@@ -1006,10 +1024,10 @@ class DeepSpeedEngine:
         (extra fwd — for exact-parity UX only; prefer train_batch)."""
         self._check_not_destroyed()
         self._grad_acc_buffer.append(batch)
-        with self.topology.mesh:
+        with trace_span("train.forward"), self.topology.mesh:
             placed = self._place_batch(batch, microbatched=False)
             loss = self._eval_step(self.state, placed, self._next_rng())
-        self._last_loss = float(loss)
+            self._last_loss = float(loss)
         return self._last_loss
 
     def __call__(self, batch):
@@ -1068,7 +1086,7 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch) -> float:
         self._check_not_destroyed()
-        with self.topology.mesh:
+        with trace_span("train.eval_batch"), self.topology.mesh:
             placed = self._place_batch(batch, microbatched=False)
             return float(self._eval_step(self.state, placed, self._next_rng()))
 

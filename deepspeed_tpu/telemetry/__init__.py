@@ -1,0 +1,81 @@
+"""Telemetry spine (ISSUE 4): one observability layer across training
+and serving.
+
+Three pieces:
+
+- **metrics registry** (:mod:`.registry`): named counters / gauges /
+  log-bucketed histograms with a flat ``snapshot()`` and a Prometheus
+  text endpoint (:mod:`.server`, ``DS_METRICS_PORT``, off by default).
+  All names are minted in the :mod:`.metrics` catalog
+  (``ds_<area>_<name>``) and linted by ``tools/check_metrics.py``.
+- **span tracer** (:mod:`.tracer`): ``trace_span("fastgen.dispatch")``
+  records into a bounded ring buffer, exportable as Chrome-trace JSON
+  via :func:`dump_trace` (Perfetto-loadable); a
+  ``jax.profiler.TraceAnnotation`` is emitted under the same name so
+  host spans line up with device timelines in captured profiles.
+- **SLO histograms**: TTFT / inter-token latency / queue wait /
+  step wall time recorded per request at drain time by the
+  FastGenScheduler.
+
+Everything is gated on one process-wide flag (``DS_TELEMETRY=1``,
+:func:`enable`, or the ``telemetry`` config block); the disabled path is
+a single branch with no allocation.
+"""
+
+from __future__ import annotations
+
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry, get_registry, log_buckets)
+from . import metrics  # noqa: F401  — mint the full ds_* catalog
+from .server import (maybe_start_from_env,  # noqa: F401
+                     start_http_server, stop_http_server)
+from .state import state  # noqa: F401
+from .tracer import (SpanTracer, dump_trace,  # noqa: F401
+                     get_tracer, trace_span)
+
+
+def enabled() -> bool:
+    return state.enabled
+
+
+def enable() -> None:
+    set_enabled(True)
+
+
+def disable() -> None:
+    set_enabled(False)
+
+
+def set_enabled(on: bool) -> None:
+    on = bool(on)
+    if on and not state.enabled:
+        state.generation += 1
+    state.enabled = on
+
+
+def apply_settings(enabled: "bool | None", metrics_port: int = 0,
+                   trace_buffer: int = 0) -> None:
+    """Push a ``telemetry`` config block into the process-wide state —
+    the single implementation behind both the runtime config's and the
+    inference-v2 config's ``TelemetryConfig.apply()``.  ``enabled=None``
+    keeps the current process flag; ``metrics_port``/``trace_buffer`` of
+    0 mean off / keep current capacity."""
+    if enabled is not None:
+        set_enabled(enabled)
+    if trace_buffer:
+        get_tracer().resize(trace_buffer)
+    if metrics_port:
+        try:
+            start_http_server(metrics_port)
+        except OSError as e:
+            # every rank shares the config — only one bind per host can
+            # win, and the losers must still build their engine
+            from ..utils.logging import logger
+            logger.warning(
+                "telemetry.metrics_port=%d: endpoint not started "
+                "(%s) — continuing without it", metrics_port, e)
+
+
+# honor DS_METRICS_PORT as soon as telemetry is imported (the import is
+# reached via deepspeed_tpu.utils.comms_logging, i.e. any engine build)
+maybe_start_from_env()

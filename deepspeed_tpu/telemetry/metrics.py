@@ -1,0 +1,80 @@
+"""Central metric catalog — every ``ds_*`` name this repo emits.
+
+All metric NAMES are minted here (components import the objects, never
+call ``registry.counter(...)`` with a novel name), so the namespace has
+one place to drift from — and ``tools/check_metrics.py`` lints this
+registry against docs/DESIGN.md's metric table in tier-1.
+
+Naming convention: ``ds_<area>_<name>`` with area one of
+{serving, comm, kv, train, fastgen}; counters end in ``_total``.
+"""
+
+from __future__ import annotations
+
+from .registry import get_registry
+
+registry = get_registry()
+
+# -- serving transfer/program accounting (ISSUE 2/3 counters) ---------------
+SERVING_PROGRAMS = registry.counter(
+    "ds_serving_programs_total", "compiled-step program dispatches")
+SERVING_STEPS = registry.counter(
+    "ds_serving_steps_total", "scheduler steps")
+SERVING_H2D_BYTES = registry.counter(
+    "ds_serving_h2d_bytes_total",
+    "host->device bytes of batch/sampling arrays fed to programs")
+SERVING_D2H_BYTES = registry.counter(
+    "ds_serving_d2h_bytes_total", "device->host bytes actually synced")
+SERVING_LOGITS_BYTES = registry.counter(
+    "ds_serving_logits_bytes_total",
+    "vocab-wide [n,V] logits buffers materialized across put()")
+SERVING_PREFIX_LOOKUP_TOKENS = registry.counter(
+    "ds_serving_prefix_lookup_tokens_total",
+    "prompt tokens offered for prefix-cache matching")
+SERVING_PREFIX_HIT_TOKENS = registry.counter(
+    "ds_serving_prefix_hit_tokens_total",
+    "prompt tokens served from cached pages")
+SERVING_PREFIX_EVICTED_PAGES = registry.counter(
+    "ds_serving_prefix_evicted_pages_total",
+    "prefix-cache pages LRU-evicted under pool pressure")
+SERVING_PREFILL_TOKENS = registry.counter(
+    "ds_serving_prefill_tokens_total", "prompt tokens actually prefilled")
+
+# -- gradient-collective wire plan (CollectiveScheduler) --------------------
+COMM_BUCKET_COUNT = registry.gauge(
+    "ds_comm_bucket_count", "gradient-collective buckets per step")
+COMM_WIRE_BYTES = registry.gauge(
+    "ds_comm_wire_bytes_per_step", "bytes on the wire per train step")
+COMM_FP32_BYTES = registry.gauge(
+    "ds_comm_fp32_bytes_per_step",
+    "fp32-equivalent gradient bytes per train step")
+COMM_QUANTIZED_FRACTION = registry.gauge(
+    "ds_comm_quantized_fraction",
+    "fraction of gradient wire volume riding the quantized path")
+
+# -- KV-pool page states (bound to the live allocator at engine build) ------
+KV_FREE_PAGES = registry.gauge(
+    "ds_kv_free_pages", "KV pool free-list pages")
+KV_LIVE_PAGES = registry.gauge(
+    "ds_kv_live_pages", "KV pool pages referenced by block tables")
+KV_PARKED_PAGES = registry.gauge(
+    "ds_kv_parked_pages",
+    "KV pool refcount-0 pages retained by the prefix cache")
+KV_TOTAL_PAGES = registry.gauge(
+    "ds_kv_total_pages", "KV pool size in pages")
+
+# -- training throughput ----------------------------------------------------
+TRAIN_SAMPLES_PER_SEC = registry.gauge(
+    "ds_train_samples_per_sec", "ThroughputTimer samples/s")
+TRAIN_STEP_TIME_MS = registry.histogram(
+    "ds_train_step_time_ms", "train_batch wall time per global step")
+
+# -- serving SLO histograms (recorded per request at drain time) ------------
+FASTGEN_TTFT_MS = registry.histogram(
+    "ds_fastgen_ttft_ms", "time to first token, submit -> host-visible")
+FASTGEN_ITL_MS = registry.histogram(
+    "ds_fastgen_itl_ms", "inter-token latency between host-visible tokens")
+FASTGEN_QUEUE_WAIT_MS = registry.histogram(
+    "ds_fastgen_queue_wait_ms", "submit -> first scheduled admission")
+FASTGEN_STEP_MS = registry.histogram(
+    "ds_fastgen_step_ms", "scheduler step wall time")

@@ -1,0 +1,105 @@
+"""Prometheus-style metrics endpoint on a stdlib http.server thread.
+
+Off by default; enabled by ``DS_METRICS_PORT=<port>`` (or the runtime
+config's ``telemetry.metrics_port``).  Serves:
+
+- ``/metrics``  — Prometheus text exposition of the registry
+- ``/snapshot`` — the registry's flat JSON snapshot
+- ``/trace``    — current span ring buffer as Chrome-trace JSON
+
+Binds ``DS_METRICS_ADDR`` (default 127.0.0.1).  Port 0 picks an
+ephemeral port (tests); the bound port is on the returned server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .registry import get_registry
+from .tracer import get_tracer
+
+_server: Optional[ThreadingHTTPServer] = None
+_lock = threading.Lock()
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = get_registry().prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/snapshot":
+            body = json.dumps(get_registry().snapshot()).encode()
+            ctype = "application/json"
+        elif path == "/trace":
+            body = json.dumps({
+                "traceEvents": get_tracer().chrome_events(),
+                "displayTimeUnit": "ms"}).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet: no per-scrape stderr spam
+        pass
+
+
+def start_http_server(port: int,
+                      addr: Optional[str] = None) -> ThreadingHTTPServer:
+    """Start (or return the already-running) metrics server."""
+    global _server
+    with _lock:
+        if _server is not None:
+            bound = _server.server_address[1]
+            if int(port) not in (0, bound):
+                from ..utils.logging import logger
+                logger.warning(
+                    "metrics server already bound to port %d; ignoring "
+                    "request for port %d (one endpoint per process)",
+                    bound, int(port))
+            return _server
+        addr = addr if addr is not None else os.environ.get(
+            "DS_METRICS_ADDR", "127.0.0.1")
+        srv = ThreadingHTTPServer((addr, int(port)), _MetricsHandler)
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever,
+                             name="ds-metrics-http", daemon=True)
+        t.start()
+        _server = srv
+        return srv
+
+
+def stop_http_server() -> None:
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+            _server = None
+
+
+def maybe_start_from_env() -> Optional[ThreadingHTTPServer]:
+    """Honor ``DS_METRICS_PORT`` (off when unset/0).  Bind failures
+    degrade to a warning, never an import error: in a multi-process job
+    every rank inherits the env var, and only the first bind on a host
+    can win — the rest must still be able to ``import deepspeed_tpu``."""
+    port = os.environ.get("DS_METRICS_PORT", "")
+    if not port or port == "0":
+        return None
+    try:
+        return start_http_server(int(port))
+    except (OSError, ValueError) as e:
+        from ..utils.logging import logger
+        logger.warning(
+            "DS_METRICS_PORT=%s: metrics endpoint not started (%s) — "
+            "continuing without it", port, e)
+        return None

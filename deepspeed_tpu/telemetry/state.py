@@ -1,0 +1,31 @@
+"""Process-wide telemetry on/off switch.
+
+A single attribute read (``state.enabled``) is the whole disabled-path
+cost of every span/SLO site, so the flag lives in its own tiny module
+that imports nothing but stdlib — the registry, tracer, and every
+instrumented hot path share it without import cycles.
+
+Enabled via ``DS_TELEMETRY=1`` (read once at import), the runtime
+``telemetry`` config block, or :func:`deepspeed_tpu.telemetry.enable`.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class _TelemetryState:
+    __slots__ = ("enabled", "generation")
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+        #: bumped on every off->on transition (see
+        #: :func:`deepspeed_tpu.telemetry.set_enabled`) so SLO stamps
+        #: taken in an earlier enabled window can be recognized as
+        #: stale — an ITL reference from before a disabled gap must not
+        #: observe the whole gap as one giant inter-token latency
+        self.generation = 1
+
+
+state = _TelemetryState(
+    os.environ.get("DS_TELEMETRY", "") not in ("", "0"))

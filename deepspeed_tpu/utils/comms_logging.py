@@ -12,6 +12,8 @@ import math
 from collections import defaultdict
 from typing import Any, Dict, List
 
+from ..telemetry import metrics as tm
+
 
 def get_msg_size(tensor) -> int:
     try:
@@ -40,52 +42,88 @@ class ServingCounters:
     Vocab-wide ``[n, V]`` logits buffers handed across the put()
     contract are tracked separately (``logits_exposed_bytes``): they are
     materialized device buffers whose sync is the caller's choice — the
-    fused sampling path never creates them at all."""
+    fused sampling path never creates them at all.
+
+    ISSUE 4: the storage is the telemetry registry's ``ds_serving_*``
+    counters — this class is now a facade (record methods + legacy field
+    names as properties + the derived per-step snapshot) over the one
+    source of truth that bench.py, the /metrics endpoint, and the
+    monitor all read."""
 
     def __init__(self):
-        self.reset()
+        self._counters = (
+            tm.SERVING_PROGRAMS, tm.SERVING_STEPS, tm.SERVING_H2D_BYTES,
+            tm.SERVING_D2H_BYTES, tm.SERVING_LOGITS_BYTES,
+            tm.SERVING_PREFIX_LOOKUP_TOKENS, tm.SERVING_PREFIX_HIT_TOKENS,
+            tm.SERVING_PREFIX_EVICTED_PAGES, tm.SERVING_PREFILL_TOKENS)
 
     def reset(self) -> None:
-        self.programs = 0            # compiled-step dispatches
-        self.steps = 0               # scheduler steps
-        self.h2d_bytes = 0           # batch/sampling arrays fed to programs
-        self.d2h_bytes = 0           # bytes actually synced to host
-        self.logits_exposed_bytes = 0  # [n, V] buffers returned by put()
-        # prefix cache (ISSUE 3): prompt tokens offered for matching,
-        # tokens served from cached pages, pages LRU-evicted under pool
-        # pressure, and prompt tokens actually prefilled (drops by the
-        # hit fraction when the cache is warm)
-        self.prefix_lookup_tokens = 0
-        self.prefix_hit_tokens = 0
-        self.prefix_evicted_pages = 0
-        self.prefill_tokens = 0
+        for c in self._counters:
+            c.reset()
+
+    # -- legacy field names, backed by the registry ------------------------
+    @property
+    def programs(self) -> int:
+        return tm.SERVING_PROGRAMS.value
+
+    @property
+    def steps(self) -> int:
+        return tm.SERVING_STEPS.value
+
+    @property
+    def h2d_bytes(self) -> int:
+        return tm.SERVING_H2D_BYTES.value
+
+    @property
+    def d2h_bytes(self) -> int:
+        return tm.SERVING_D2H_BYTES.value
+
+    @property
+    def logits_exposed_bytes(self) -> int:
+        return tm.SERVING_LOGITS_BYTES.value
+
+    @property
+    def prefix_lookup_tokens(self) -> int:
+        return tm.SERVING_PREFIX_LOOKUP_TOKENS.value
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        return tm.SERVING_PREFIX_HIT_TOKENS.value
+
+    @property
+    def prefix_evicted_pages(self) -> int:
+        return tm.SERVING_PREFIX_EVICTED_PAGES.value
+
+    @property
+    def prefill_tokens(self) -> int:
+        return tm.SERVING_PREFILL_TOKENS.value
 
     def record_step(self) -> None:
-        self.steps += 1
+        tm.SERVING_STEPS.inc()
 
     def record_program(self, h2d_bytes: int = 0) -> None:
-        self.programs += 1
-        self.h2d_bytes += int(h2d_bytes)
+        tm.SERVING_PROGRAMS.inc()
+        tm.SERVING_H2D_BYTES.inc(int(h2d_bytes))
 
     def record_h2d(self, nbytes: int) -> None:
-        self.h2d_bytes += int(nbytes)
+        tm.SERVING_H2D_BYTES.inc(int(nbytes))
 
     def record_d2h(self, nbytes: int) -> None:
-        self.d2h_bytes += int(nbytes)
+        tm.SERVING_D2H_BYTES.inc(int(nbytes))
 
     def record_logits_exposed(self, nbytes: int) -> None:
-        self.logits_exposed_bytes += int(nbytes)
+        tm.SERVING_LOGITS_BYTES.inc(int(nbytes))
 
     def record_prefix_lookup(self, lookup_tokens: int,
                              hit_tokens: int) -> None:
-        self.prefix_lookup_tokens += int(lookup_tokens)
-        self.prefix_hit_tokens += int(hit_tokens)
+        tm.SERVING_PREFIX_LOOKUP_TOKENS.inc(int(lookup_tokens))
+        tm.SERVING_PREFIX_HIT_TOKENS.inc(int(hit_tokens))
 
     def record_prefix_evicted(self, num_pages: int) -> None:
-        self.prefix_evicted_pages += int(num_pages)
+        tm.SERVING_PREFIX_EVICTED_PAGES.inc(int(num_pages))
 
     def record_prefill(self, num_tokens: int) -> None:
-        self.prefill_tokens += int(num_tokens)
+        tm.SERVING_PREFILL_TOKENS.inc(int(num_tokens))
 
     def snapshot(self) -> Dict[str, Any]:
         steps = max(self.steps, 1)
@@ -135,6 +173,12 @@ class CommsLogger:
         ``CollectiveScheduler.stats``) so log_summary can attribute
         gradient-collective volume per bucket."""
         self.bucket_plan = dict(stats)
+        tm.COMM_BUCKET_COUNT.set(stats.get("bucket_count", 0))
+        tm.COMM_WIRE_BYTES.set(stats.get("comm_bytes_per_step", 0))
+        tm.COMM_FP32_BYTES.set(
+            stats.get("comm_fp32_equiv_bytes_per_step", 0))
+        tm.COMM_QUANTIZED_FRACTION.set(
+            stats.get("comm_quantized_fraction", 0.0))
         if self.verbose:
             from .logging import logger
             logger.info(

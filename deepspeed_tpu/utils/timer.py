@@ -12,6 +12,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
+from ..telemetry import metrics as tm
 from ..utils.logging import log_dist
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
@@ -40,7 +41,12 @@ class _Timer:
             return
         self.started = False
         elapsed = time.perf_counter() - self._start
-        if record:
+        if reset:
+            # reference _Timer.stop(reset=True): this interval REPLACES
+            # the accumulator instead of adding to it
+            self._elapsed = elapsed if record else 0.0
+            self.count = 1 if record else 0
+        elif record:
             self._elapsed += elapsed
             self.count += 1
 
@@ -103,6 +109,13 @@ class ThroughputTimer:
             self.global_step_count += 1
             if self.global_step_count >= self.start_step:
                 self.total_elapsed_time += self.step_elapsed_time
+                # registry-backed throughput (ISSUE 4): the monitor,
+                # the /metrics endpoint, and the flops profiler all
+                # read these instead of private timer fields.  Gated on
+                # start_step like total_elapsed_time, so the JIT-compile
+                # first step(s) can't pollute the latency percentiles.
+                tm.TRAIN_STEP_TIME_MS.observe(self.step_elapsed_time * 1e3)
+                tm.TRAIN_SAMPLES_PER_SEC.set(self.avg_samples_per_sec())
             if report_speed and self.global_step_count % self.steps_per_output == 0:
                 log_dist(
                     f"step={self.global_step_count}, "
@@ -115,3 +128,12 @@ class ThroughputTimer:
         if self.total_elapsed_time <= 0:
             return 0.0
         return self.batch_size * counted / self.total_elapsed_time
+
+    def avg_step_time(self) -> float:
+        """Mean wall seconds per counted global step (the flops
+        profiler's duration input — its ``hasattr`` fallback reported
+        0 ms / no MFU before this existed)."""
+        counted = max(self.global_step_count - self.start_step + 1, 1)
+        if self.total_elapsed_time <= 0:
+            return 0.0
+        return self.total_elapsed_time / counted

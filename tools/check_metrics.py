@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Metric-namespace lint (ISSUE 4 CI satellite).
+
+Asserts that every metric registered in the telemetry registry
+
+- matches the ``ds_<area>_<name>`` naming convention with a known area
+  (counters additionally end in ``_total``), and
+- is documented in docs/DESIGN.md's "Telemetry" metric table,
+
+so the namespace cannot silently drift: adding a metric without
+documenting it (or with an off-convention name) fails tier-1
+(tests/test_telemetry.py runs :func:`check`) and this script
+(``python tools/check_metrics.py``) exits non-zero.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+AREAS = ("serving", "comm", "kv", "train", "fastgen")
+NAME_RE = re.compile(
+    r"^ds_(%s)_[a-z][a-z0-9_]*$" % "|".join(AREAS))
+
+
+def check(design_path: str = None) -> List[str]:
+    """Return a list of lint errors (empty = clean)."""
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from deepspeed_tpu.telemetry import Counter, get_registry
+    from deepspeed_tpu.telemetry import metrics  # noqa: F401 — mint catalog
+
+    if design_path is None:
+        design_path = os.path.join(REPO_ROOT, "docs", "DESIGN.md")
+    with open(design_path) as f:
+        design = f.read()
+
+    errors = []
+    registered = get_registry().all_metrics()
+    if not registered:
+        errors.append("no metrics registered — catalog import broken?")
+    for name, metric in sorted(registered.items()):
+        if not NAME_RE.match(name):
+            errors.append(
+                f"{name}: does not match ds_<area>_<name> "
+                f"(area in {AREAS}, lowercase [a-z0-9_])")
+        if isinstance(metric, Counter) and not name.endswith("_total"):
+            errors.append(f"{name}: counters must end in _total")
+        if f"`{name}`" not in design:
+            errors.append(
+                f"{name}: not documented in docs/DESIGN.md "
+                "(add a row to the Telemetry metric table)")
+        if not metric.help:
+            errors.append(f"{name}: registered without help text")
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    for e in errors:
+        print(f"check_metrics: {e}", file=sys.stderr)
+    if errors:
+        print(f"check_metrics: {len(errors)} error(s)", file=sys.stderr)
+        return 1
+    from deepspeed_tpu.telemetry import get_registry
+    print(f"check_metrics: {len(get_registry().all_metrics())} metrics OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
