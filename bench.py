@@ -26,6 +26,11 @@ REMAT_POLICY = os.environ.get("BENCH_REMAT", "save_attn_out")
 # peak bf16 FLOPs/s per chip (TPU v5e ~ 394 TFLOPs int8 / 197 bf16)
 PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
+#: goodput ratio stamped by the training leg's telemetry-on coda at ITS
+#: wall-clock moment (the gauge is wall-relative: reading it from the
+#: later fastgen SLO leg would dilute the ratio with inference time)
+_TRAIN_GOODPUT = None
+
 
 def _emit_error(stage, err):
     """Print the one JSON artifact line for a failed run and exit 0.
@@ -330,6 +335,12 @@ def bench_fastgen(jax):
                 for h in (tmet.FASTGEN_TTFT_MS, tmet.FASTGEN_ITL_MS,
                           tmet.FASTGEN_QUEUE_WAIT_MS, tmet.FASTGEN_STEP_MS):
                     h.reset()
+                # recompile accounting (ISSUE 5): the warmups above
+                # compiled every bucket this workload hits, so misses in
+                # the measured window ARE on-request-path recompiles —
+                # the bench trajectory should show 0 and flag drift
+                tmet.FASTGEN_STEP_CACHE_MISS.reset()
+                tmet.FASTGEN_COMPILE_ON_PATH.reset()
                 telemetry.get_tracer().clear()
                 # the prefix leg may have bound the ds_kv_* gauges to
                 # its dedicated engine — rebind to the measured one
@@ -348,6 +359,20 @@ def bench_fastgen(jax):
                     tmet.FASTGEN_QUEUE_WAIT_MS.percentile(50), 1)
                 result["fastgen_step_p99_ms"] = round(
                     tmet.FASTGEN_STEP_MS.percentile(99), 2)
+                result["fastgen_step_cache_miss_total"] = \
+                    tmet.FASTGEN_STEP_CACHE_MISS.value
+                result["fastgen_compile_on_path_total"] = \
+                    tmet.FASTGEN_COMPILE_ON_PATH.value
+                # goodput (ISSUE 5): stamped by the training leg's
+                # telemetry-on coda at its own wall-clock moment.  When
+                # no coda ran AND the gauge was never bound, OMIT the
+                # key — an untouched gauge reads 0.0, which check_bench
+                # would misread as a -100% goodput regression
+                if _TRAIN_GOODPUT is not None:
+                    result["train_goodput_ratio"] = _TRAIN_GOODPUT
+                elif tmet.TRAIN_GOODPUT_RATIO.touched:
+                    result["train_goodput_ratio"] = round(
+                        float(tmet.TRAIN_GOODPUT_RATIO.value), 4)
                 if os.environ.get("BENCH_TRACE", "") not in ("", "0"):
                     # Chrome-trace artifact of the SLO leg, loadable in
                     # Perfetto, written alongside the BENCH_*.json line
@@ -529,6 +554,30 @@ def _train_and_report(jax, n_chips, cpu_fallback=None):
         result["vs_baseline"] = 0
         result["cpu_fallback"] = True
         result["tpu_error"] = cpu_fallback
+    if os.environ.get("BENCH_SLO", "1") != "0":
+        # goodput coda (ISSUE 5): a couple of telemetry-ON steps OUTSIDE
+        # the timed window feed the watchdog's goodput phase
+        # accumulators; the ratio is read back immediately (the gauge is
+        # wall-clock-relative, so reading it later — e.g. from the
+        # fastgen SLO leg — would dilute it with inference wall time).
+        # Headline timings above stay telemetry-off and comparable.
+        try:
+            from deepspeed_tpu import telemetry
+            from deepspeed_tpu.telemetry import metrics as tmet
+            was_enabled = telemetry.enabled()
+            telemetry.enable()
+            try:
+                for _ in range(2):
+                    engine.train_batch(batch)
+                jax.block_until_ready(engine.state.params)
+            finally:
+                telemetry.set_enabled(was_enabled)
+            global _TRAIN_GOODPUT
+            _TRAIN_GOODPUT = round(
+                float(tmet.TRAIN_GOODPUT_RATIO.value), 4)
+            result["train_goodput_ratio"] = _TRAIN_GOODPUT
+        except Exception as e:  # noqa: BLE001 — coda must not kill bench
+            sys.stderr.write(f"bench: train goodput coda failed: {e}\n")
     del engine  # release training buffers before the inference leg
     if os.environ.get("BENCH_FASTGEN", "1") != "0":
         result.update(bench_fastgen(jax))

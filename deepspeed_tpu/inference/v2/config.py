@@ -66,14 +66,26 @@ class TelemetryConfig:
     ``telemetry`` block.  ``enabled=None`` inherits the process state
     (``DS_TELEMETRY`` / ``telemetry.enable()``); ``metrics_port``
     starts the Prometheus endpoint (0 = off); ``trace_buffer`` resizes
-    the span ring (0 = keep current capacity)."""
+    the span ring (0 = keep current capacity).  ISSUE 5 watchdog /
+    flight-recorder knobs follow the same keep-current convention
+    (see the runtime config's ``TelemetryConfig`` for semantics)."""
     enabled: Optional[bool] = None
     metrics_port: int = 0
     trace_buffer: int = 0
+    watchdog: Optional[bool] = None
+    watchdog_threshold: float = 0.0
+    watchdog_warmup: int = -1
+    postmortem_dir: str = ""
+    flight_recorder_events: int = 0
 
     def apply(self) -> None:
         from ...telemetry import apply_settings
-        apply_settings(self.enabled, self.metrics_port, self.trace_buffer)
+        apply_settings(self.enabled, self.metrics_port, self.trace_buffer,
+                       watchdog=self.watchdog,
+                       watchdog_threshold=self.watchdog_threshold,
+                       watchdog_warmup=self.watchdog_warmup,
+                       postmortem_dir=self.postmortem_dir,
+                       flight_recorder_events=self.flight_recorder_events)
 
 
 @dataclasses.dataclass

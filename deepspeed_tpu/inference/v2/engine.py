@@ -88,6 +88,14 @@ class InferenceEngineV2:
             prefix_caching=self._config.serving.prefix_caching)
         self._config.telemetry.apply()
         self._bind_kv_gauges()
+        # flight recorder (ISSUE 5): capture the serving config + a
+        # lifecycle event at engine build
+        from ...telemetry.flight_recorder import get_flight_recorder
+        recorder = get_flight_recorder()
+        recorder.set_config("inference_v2", self._config)
+        recorder.record("engine.build", engine="fastgen",
+                        kv_pages=kv_cfg.num_pages,
+                        page_size=kv_cfg.page_size)
 
     def _bind_kv_gauges(self) -> None:
         """Bind the ``ds_kv_*`` page-state gauges to this engine's live

@@ -25,6 +25,7 @@ from ...models import transformer as T
 from ...ops.paged_attention import (gather_last, paged_attention,
                                     rope_write_kv, token_positions,
                                     write_kv)
+from ...telemetry.watchdog import get_watchdog
 from .ragged import KVCacheConfig, RaggedBatch
 
 
@@ -320,7 +321,12 @@ class RaggedInferenceModel:
         key = self._normalize_key(key[:4]) + tuple(key[4:])
         fn = self._step_cache.get(key)
         if fn is None:
+            # recompile accounting (ISSUE 5): a miss here IS the
+            # request path — either a strict-shapes refusal or an XLA
+            # compile eaten as a TTFT spike.  The watchdog counts both
+            # and warns on recompile storms, naming the uncovered key.
             if getattr(self, "strict_shapes", False):
+                get_watchdog().note_step_cache(hit=False, key=key)
                 raise RuntimeError(
                     f"batch bucket {key} (S, Q, P, fresh[, kind, ...]) "
                     "was not precompiled — live serving would eat this "
@@ -328,8 +334,12 @@ class RaggedInferenceModel:
                     "InferenceEngineV2.precompile(...) (sampling=True "
                     "covers the fused sample/chain variants) or disable "
                     "strict_shapes.")
+            get_watchdog().note_step_cache(hit=False, key=key,
+                                           compiled_on_path=True)
             fn = jax.jit(self._impl_of(key), donate_argnums=(1,))
             self._step_cache[key] = fn
+        else:
+            get_watchdog().note_step_cache(hit=True)
         return fn
 
     def _fresh_of(self, key) -> bool:

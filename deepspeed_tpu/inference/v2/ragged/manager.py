@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ....telemetry import trace_span
+from ....telemetry.flight_recorder import get_flight_recorder
 from ....utils.comms_logging import serving_counters
 from .blocked_allocator import NULL_PAGE
 from .kv_cache import BlockedKVCache, KVCacheConfig
@@ -103,6 +104,8 @@ class StateManager:
             if evicted:
                 alloc.reclaim(evicted)
                 serving_counters.record_prefix_evicted(len(evicted))
+                get_flight_recorder().record("kv.evict",
+                                             pages=len(evicted))
 
     # -- prefix cache -------------------------------------------------------
     def match_prefix(self, sd: SequenceDescriptor,

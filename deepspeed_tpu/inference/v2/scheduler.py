@@ -37,7 +37,9 @@ import numpy as np
 
 from ...telemetry import get_tracer, trace_span
 from ...telemetry import metrics as tm
+from ...telemetry.flight_recorder import get_flight_recorder
 from ...telemetry.state import state as _telemetry
+from ...telemetry.watchdog import get_watchdog
 from ...utils.comms_logging import serving_counters
 from .engine import InferenceEngineV2
 from .sampling import SamplingParams, sample
@@ -259,6 +261,8 @@ class FastGenScheduler:
             if (len(req.generated) >= req.params.max_new_tokens
                     or (stop is not None and tok == stop)):
                 req.done = True
+                get_flight_recorder().record(
+                    "request.done", uid=uid, tokens=len(req.generated))
                 self._engine.flush(uid)
                 self._running.pop(uid, None)
         return out
@@ -335,19 +339,31 @@ class FastGenScheduler:
         sequence whose token became host-visible this step (with
         async_scheduling that is the PREVIOUS step's tokens — one-step
         lag)."""
-        if _telemetry.enabled:
-            # spans from this step (and everything nested under it) are
-            # labelled with THIS scheduler's own step ordinal — not
-            # derived from the tracer's current label, which a training
-            # engine sharing the process (hybrid RLHF) also writes
-            self._step_ordinal += 1
-            get_tracer().set_step(self._step_ordinal)
-            t0 = time.perf_counter()
-            with trace_span("fastgen.step"):
+        try:
+            if _telemetry.enabled:
+                # spans from this step (and everything nested under it)
+                # are labelled with THIS scheduler's own step ordinal —
+                # not derived from the tracer's current label, which a
+                # training engine sharing the process (hybrid RLHF) also
+                # writes
+                self._step_ordinal += 1
+                get_tracer().set_step(self._step_ordinal)
+                t0 = time.perf_counter()
+                with trace_span("fastgen.step"):
+                    out = self._step_impl(on_token)
+                step_ms = (time.perf_counter() - t0) * 1e3
+                tm.FASTGEN_STEP_MS.observe(step_ms)
+                # EWMA anomaly detector (ISSUE 5): a recompile or a KV
+                # thrash shows up here as a step-time spike
+                get_watchdog().observe_step_time(
+                    "fastgen", step_ms, step=self._step_ordinal)
+            else:
                 out = self._step_impl(on_token)
-            tm.FASTGEN_STEP_MS.observe((time.perf_counter() - t0) * 1e3)
-        else:
-            out = self._step_impl(on_token)
+        except Exception as e:
+            # crash forensics (ISSUE 5): leave a postmortem bundle
+            # before the exception leaves the step loop; never masks it
+            get_flight_recorder().on_crash("fastgen.step", e)
+            raise
         if self._kv_debug:
             self._engine.state_manager.check_invariants()
         return out
@@ -415,6 +431,8 @@ class FastGenScheduler:
                         if sd.host_blob is not None else 0)
                 if self._engine.free_blocks >= need + 1:
                     self._engine.restore_sequence(uid)
+                    get_flight_recorder().record("request.restore",
+                                                 uid=uid)
                     self._running[uid] = self._preempted.pop(uid)
 
             adm = _Admission(self._engine, self._budget)
@@ -469,6 +487,10 @@ class FastGenScheduler:
                     if req.submit_s:
                         tm.FASTGEN_QUEUE_WAIT_MS.observe(
                             (req.first_sched_s - req.submit_s) * 1e3)
+                    get_flight_recorder().record(
+                        "request.admit", uid=req.uid,
+                        prompt_tokens=len(req.prompt),
+                        cached_tokens=req.prompt_sent - chunk)
                 return True
 
             for req in list(self._running.values()):
@@ -499,6 +521,8 @@ class FastGenScheduler:
                 if live_pages(victim) > 0:
                     with trace_span("fastgen.preempt"):
                         self._engine.offload_sequence(victim)
+                    get_flight_recorder().record("request.preempt",
+                                                 uid=victim)
                     self._preempted[victim] = self._running.pop(victim)
                     self._preempted_this_step = True
             return out_prev
@@ -583,6 +607,9 @@ class FastGenScheduler:
             if (len(req.generated) >= req.params.max_new_tokens
                     or (stop is not None and tok == stop)):
                 req.done = True
+                get_flight_recorder().record(
+                    "request.done", uid=req.uid,
+                    tokens=len(req.generated))
                 self._engine.flush(req.uid)
                 del self._running[req.uid]
         return out

@@ -229,16 +229,36 @@ class TelemetryConfig(DeepSpeedConfigModel):
     (``DS_TELEMETRY`` env / ``telemetry.enable()``); an explicit bool
     wins.  ``metrics_port`` starts the Prometheus endpoint
     (0 = off, same as ``DS_METRICS_PORT``); ``trace_buffer`` resizes the
-    span ring buffer (0 = keep the current capacity)."""
+    span ring buffer (0 = keep the current capacity).
+
+    Watchdog / flight-recorder knobs (ISSUE 5, same keep-current
+    convention): ``watchdog`` gates the health watchdog on top of the
+    process telemetry flag (null = keep, default on);
+    ``watchdog_threshold`` is the EWMA step-time anomaly ratio (0 =
+    keep, default 3.0); ``watchdog_warmup`` the EWMA samples before
+    verdicts fire (-1 = keep, default 8); ``postmortem_dir`` where
+    crash/anomaly artifacts land ("" = keep, default
+    ``DS_POSTMORTEM_DIR``); ``flight_recorder_events`` resizes the
+    structured event ring (0 = keep, default 1024)."""
     enabled: Optional[bool] = None
     metrics_port: int = 0
     trace_buffer: int = 0
+    watchdog: Optional[bool] = None
+    watchdog_threshold: float = 0.0
+    watchdog_warmup: int = -1
+    postmortem_dir: str = ""
+    flight_recorder_events: int = 0
 
     def apply(self) -> None:
         """Push this block into the process-wide telemetry state (shared
         by the runtime engine and the inference-v2 engine)."""
         from ..telemetry import apply_settings
-        apply_settings(self.enabled, self.metrics_port, self.trace_buffer)
+        apply_settings(self.enabled, self.metrics_port, self.trace_buffer,
+                       watchdog=self.watchdog,
+                       watchdog_threshold=self.watchdog_threshold,
+                       watchdog_warmup=self.watchdog_warmup,
+                       postmortem_dir=self.postmortem_dir,
+                       flight_recorder_events=self.flight_recorder_events)
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

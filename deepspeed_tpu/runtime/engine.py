@@ -35,7 +35,9 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import math
 import os
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
@@ -48,7 +50,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import comm as dist
 from ..parallel.topology import (BATCH_AXES, MeshTopology, TopologyConfig)
 from ..telemetry import get_tracer, trace_span
+from ..telemetry import metrics as tm
+from ..telemetry.flight_recorder import get_flight_recorder
 from ..telemetry.state import state as telemetry_state
+from ..telemetry.watchdog import get_watchdog
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER,
@@ -247,6 +252,17 @@ class DeepSpeedEngine:
         self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn) \
             if training_data is not None else None
         self.checkpoint_engine = self._build_checkpoint_engine()
+
+        # flight recorder (ISSUE 5): the config is captured always (a
+        # crash with telemetry off should still identify what ran); the
+        # lifecycle event is enabled-gated inside record()
+        self._monitor_write_warned = False
+        recorder = get_flight_recorder()
+        recorder.set_config("runtime", self.config)
+        recorder.record(
+            "engine.build", engine="train", zero_stage=self.zero_stage,
+            micro_bs=self.train_micro_batch_size_per_gpu(),
+            gas=self.gradient_accumulation_steps())
 
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} "
@@ -551,9 +567,28 @@ class DeepSpeedEngine:
         try:
             from ..monitor.monitor import MonitorMaster
             return MonitorMaster(self.config)
-        except Exception as e:  # monitor optional
-            logger.debug("monitor disabled: %s", e)
+        except Exception as e:  # monitor optional — but say WHY it's off
+            logger.warning(
+                "monitor disabled (%s: %s) — training continues without "
+                "monitor writers", type(e).__name__, e)
             return None
+
+    def _monitor_write(self, fn, *args) -> None:
+        """Run one monitor write batch.  A raising writer (full disk,
+        dead tensorboard socket, wandb auth) must not kill the training
+        step — but it must not vanish either: warn once with the
+        exception class and count every dropped batch in
+        ``ds_train_monitor_drop_total``."""
+        try:
+            fn(*args)
+        except Exception as e:
+            tm.TRAIN_MONITOR_DROP.inc()
+            if not self._monitor_write_warned:
+                self._monitor_write_warned = True
+                logger.warning(
+                    "monitor write failed (%s: %s) — dropped; further "
+                    "drops are counted in ds_train_monitor_drop_total "
+                    "without logging", type(e).__name__, e)
 
     def _build_checkpoint_engine(self):
         from ..checkpoint.engine import OrbaxCheckpointEngine
@@ -898,6 +933,15 @@ class DeepSpeedEngine:
     def train_batch(self, batch=None, data_iter: Optional[Iterable] = None) -> float:
         """Run one full training step: gas micro-batches + optimizer update
         (reference PipelineEngine.train_batch / engine fwd+bwd+step cycle)."""
+        try:
+            return self._train_batch_impl(batch, data_iter)
+        except Exception as e:
+            # crash forensics (ISSUE 5): leave a postmortem bundle
+            # before the exception leaves the engine; never masks it
+            get_flight_recorder().on_crash("train_batch", e)
+            raise
+
+    def _train_batch_impl(self, batch, data_iter) -> float:
         self._check_not_destroyed()
         if batch is None:
             source = data_iter if data_iter is not None else self.training_dataloader
@@ -918,10 +962,14 @@ class DeepSpeedEngine:
                 "eval-regime scoring)")
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
+        watchdog = get_watchdog()
+        t_batch0 = None
         if telemetry_state.enabled:
             get_tracer().set_step(self.global_steps)
+            t_batch0 = time.perf_counter()
         with trace_span("train.batch"), self.topology.mesh:
-            with trace_span("train.place_batch"):
+            with trace_span("train.place_batch"), \
+                    watchdog.track("input_wait"):
                 batch = self._place_batch(batch, microbatched=True)
             self._maybe_profile_flops(batch)
             # the fused step is ONE compiled program (fwd + bwd +
@@ -929,8 +977,11 @@ class DeepSpeedEngine:
             # where the host blocks on it, so train.step covers dispatch
             # + device execution.  Per-phase device attribution comes
             # from the jax profiler (the span's TraceAnnotation lines
-            # host spans up with the device timeline).
-            with trace_span("train.step"):
+            # host spans up with the device timeline).  Goodput: the
+            # first global step's wall time is compile+warmup (the jit
+            # trace happens under it), later steps bill the step phase.
+            with trace_span("train.step"), watchdog.track(
+                    "compile" if self.global_steps == 0 else "step"):
                 self.state, metrics, off_grads = self._train_step(
                     self.state, batch, self._next_rng())
                 loss = float(metrics["loss"])
@@ -939,7 +990,8 @@ class DeepSpeedEngine:
             # host must mirror it exactly or the two halves desync
             if self.offload is not None and not (
                     self._fp16_enabled and int(metrics["overflow"])):
-                with trace_span("train.offload_step"):
+                with trace_span("train.offload_step"), \
+                        watchdog.track("step"):
                     self._apply_offload_step(off_grads,
                                              float(metrics["applied_lr"]))
         from ..tools.tensor_logger import record_active
@@ -949,6 +1001,23 @@ class DeepSpeedEngine:
         self._last_grad_norm = float(metrics["grad_norm"])
         self._last_step_applied = not (self._fp16_enabled
                                        and bool(metrics["overflow"]))
+        if telemetry_state.enabled:
+            # non-finite sentinel (ISSUE 5): loss and grad_norm are the
+            # HOST-fetched floats above — no new device syncs.  A
+            # HANDLED fp16 overflow skip is routine (overflow IS
+            # ~isfinite(gnorm); the loss-scale machinery exists for it),
+            # so it feeds only the skip counter — the non-finite verdict
+            # is reserved for steps the engine actually applied.
+            if not self._last_step_applied:
+                watchdog.note_overflow_skip(self.global_steps)
+            else:
+                if not math.isfinite(loss):
+                    watchdog.note_nonfinite("loss", self.global_steps,
+                                            loss)
+                if not math.isfinite(self._last_grad_norm):
+                    watchdog.note_nonfinite("grad_norm",
+                                            self.global_steps,
+                                            self._last_grad_norm)
         self.global_steps += 1
         self._maybe_apply_compression()
         self.micro_steps += self.gradient_accumulation_steps()
@@ -956,15 +1025,22 @@ class DeepSpeedEngine:
         self.lr_scheduler.step()
         self.tput_timer.stop(report_speed=self.global_steps % self.config.steps_per_print == 0)
         self.timers(TRAIN_BATCH_TIMER).stop()
+        if t_batch0 is not None:
+            # EWMA step-time anomaly detector (ISSUE 5): warns once per
+            # storm and dumps the span ring around the offending step
+            watchdog.observe_step_time(
+                "train", (time.perf_counter() - t_batch0) * 1e3,
+                step=self.global_steps - 1)
         if self.monitor is not None:
-            self.monitor.write_events([
+            self._monitor_write(self.monitor.write_events, [
                 ("Train/Samples/train_loss", loss, self.global_samples),
                 ("Train/Samples/lr", float(metrics["lr"]), self.global_samples)])
             if self.global_steps % self.config.steps_per_print == 0:
                 # full telemetry-registry snapshot rides the monitor fan-
                 # out at the print cadence (one source of truth: the same
                 # names the /metrics endpoint and bench.py read)
-                self.monitor.write_registry_snapshot(self.global_samples)
+                self._monitor_write(self.monitor.write_registry_snapshot,
+                                    self.global_samples)
         if self.config.wall_clock_breakdown and \
                 self.global_steps % self.config.steps_per_print == 0:
             self.timers.log([TRAIN_BATCH_TIMER])
@@ -1114,8 +1190,17 @@ class DeepSpeedEngine:
     # --- checkpointing --------------------------------------------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True):
+        with get_watchdog().track("checkpoint"):
+            return self._save_checkpoint_impl(save_dir, tag, client_state,
+                                              save_latest)
+
+    def _save_checkpoint_impl(self, save_dir, tag, client_state,
+                              save_latest):
         self._check_not_destroyed()
         tag = tag or f"global_step{self.global_steps}"
+        get_flight_recorder().record("checkpoint.save", dir=save_dir,
+                                     tag=tag,
+                                     global_step=self.global_steps)
         client_state = dict(client_state or {})
         client_state.update({
             "global_steps": self.global_steps,
@@ -1140,7 +1225,16 @@ class DeepSpeedEngine:
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
+        with get_watchdog().track("checkpoint"):
+            return self._load_checkpoint_impl(
+                load_dir, tag, load_optimizer_states,
+                load_lr_scheduler_states, load_module_only)
+
+    def _load_checkpoint_impl(self, load_dir, tag, load_optimizer_states,
+                              load_lr_scheduler_states, load_module_only):
         self._check_not_destroyed()
+        get_flight_recorder().record("checkpoint.load", dir=load_dir,
+                                     tag=tag or "")
         if self.config.checkpoint.load_universal:
             # reference --universal-checkpoint load path: restore the
             # topology-free atoms regardless of the saving mesh.  Accepts
@@ -1293,6 +1387,8 @@ class DeepSpeedEngine:
 
     def destroy(self):
         """Drop compiled steps + device state (reference destroy)."""
+        get_flight_recorder().record("engine.destroy", engine="train",
+                                     global_steps=self.global_steps)
         self._train_step = None
         self._eval_step = None
         self._invalidate_step_caches()

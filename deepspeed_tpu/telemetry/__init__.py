@@ -32,6 +32,10 @@ from .server import (maybe_start_from_env,  # noqa: F401
 from .state import state  # noqa: F401
 from .tracer import (SpanTracer, dump_trace,  # noqa: F401
                      get_tracer, trace_span)
+from .watchdog import Watchdog, get_watchdog  # noqa: F401
+from .flight_recorder import (FlightRecorder,  # noqa: F401
+                              dump_postmortem, get_flight_recorder,
+                              maybe_install_exit_handlers)
 
 
 def enabled() -> bool:
@@ -54,16 +58,32 @@ def set_enabled(on: bool) -> None:
 
 
 def apply_settings(enabled: "bool | None", metrics_port: int = 0,
-                   trace_buffer: int = 0) -> None:
+                   trace_buffer: int = 0,
+                   watchdog: "bool | None" = None,
+                   watchdog_threshold: float = 0.0,
+                   watchdog_warmup: int = -1,
+                   postmortem_dir: str = "",
+                   flight_recorder_events: int = 0) -> None:
     """Push a ``telemetry`` config block into the process-wide state —
     the single implementation behind both the runtime config's and the
     inference-v2 config's ``TelemetryConfig.apply()``.  ``enabled=None``
     keeps the current process flag; ``metrics_port``/``trace_buffer`` of
-    0 mean off / keep current capacity."""
+    0 mean off / keep current capacity.  ISSUE 5 knobs follow the same
+    keep-current convention: ``watchdog=None``, ``watchdog_threshold=0``,
+    ``watchdog_warmup=-1``, ``postmortem_dir=""``,
+    ``flight_recorder_events=0``."""
     if enabled is not None:
         set_enabled(enabled)
     if trace_buffer:
         get_tracer().resize(trace_buffer)
+    get_watchdog().configure(enabled=watchdog,
+                             threshold=watchdog_threshold,
+                             warmup=watchdog_warmup,
+                             postmortem_dir=postmortem_dir)
+    if postmortem_dir:
+        get_flight_recorder().postmortem_dir = postmortem_dir
+    if flight_recorder_events:
+        get_flight_recorder().resize(flight_recorder_events)
     if metrics_port:
         try:
             start_http_server(metrics_port)
@@ -79,3 +99,5 @@ def apply_settings(enabled: "bool | None", metrics_port: int = 0,
 # honor DS_METRICS_PORT as soon as telemetry is imported (the import is
 # reached via deepspeed_tpu.utils.comms_logging, i.e. any engine build)
 maybe_start_from_env()
+# honor DS_POSTMORTEM_ON_EXIT the same way (atexit + SIGTERM bundle)
+maybe_install_exit_handlers()

@@ -69,6 +69,51 @@ TRAIN_SAMPLES_PER_SEC = registry.gauge(
 TRAIN_STEP_TIME_MS = registry.histogram(
     "ds_train_step_time_ms", "train_batch wall time per global step")
 
+# -- health watchdog (ISSUE 5) ----------------------------------------------
+TRAIN_NONFINITE = registry.counter(
+    "ds_train_nonfinite_total",
+    "host-fetched loss/grad-norm values that came back non-finite")
+TRAIN_OVERFLOW_SKIP = registry.counter(
+    "ds_train_overflow_skip_total",
+    "fp16 dynamic-loss-scale overflow steps skipped")
+TRAIN_ANOMALY = registry.counter(
+    "ds_train_anomaly_total",
+    "step-time anomalies flagged by the EWMA watchdog (train + fastgen)")
+TRAIN_MONITOR_DROP = registry.counter(
+    "ds_train_monitor_drop_total",
+    "monitor write batches dropped because a writer raised")
+
+# -- goodput accounting (callback gauges fed by the watchdog) ----------------
+TRAIN_GOODPUT_RATIO = registry.gauge(
+    "ds_train_goodput_ratio",
+    "fraction of wallclock spent in the fused train step")
+TRAIN_COMPILE_FRACTION = registry.gauge(
+    "ds_train_compile_fraction",
+    "fraction of wallclock spent compiling (first-trace steps)")
+TRAIN_INPUT_WAIT_FRACTION = registry.gauge(
+    "ds_train_input_wait_fraction",
+    "fraction of wallclock spent placing/waiting on input batches")
+TRAIN_STEP_FRACTION = registry.gauge(
+    "ds_train_step_fraction",
+    "fraction of wallclock spent in dispatched train steps")
+TRAIN_CHECKPOINT_FRACTION = registry.gauge(
+    "ds_train_checkpoint_fraction",
+    "fraction of wallclock spent saving/loading checkpoints")
+TRAIN_IDLE_FRACTION = registry.gauge(
+    "ds_train_idle_fraction",
+    "fraction of wallclock in none of the tracked phases")
+
+# -- serving step-cache / recompile accounting (ISSUE 5) ---------------------
+FASTGEN_STEP_CACHE_HIT = registry.counter(
+    "ds_fastgen_step_cache_hit_total",
+    "serving step-cache lookups served by a compiled program")
+FASTGEN_STEP_CACHE_MISS = registry.counter(
+    "ds_fastgen_step_cache_miss_total",
+    "serving step-cache lookups that missed the compiled lattice")
+FASTGEN_COMPILE_ON_PATH = registry.counter(
+    "ds_fastgen_compile_on_path_total",
+    "XLA compiles executed on the serving request path")
+
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(
     "ds_fastgen_ttft_ms", "time to first token, submit -> host-visible")

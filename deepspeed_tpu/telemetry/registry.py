@@ -156,7 +156,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, Union[Counter, Gauge, Histogram]] = {}
-        self._lock = threading.Lock()
+        # RLock: the postmortem SIGTERM handler snapshots the registry
+        # on the main thread and may interrupt a _get() holding this
+        self._lock = threading.RLock()
 
     def _get(self, name: str, cls, **kwargs):
         with self._lock:

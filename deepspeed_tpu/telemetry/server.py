@@ -6,6 +6,9 @@ config's ``telemetry.metrics_port``).  Serves:
 - ``/metrics``  — Prometheus text exposition of the registry
 - ``/snapshot`` — the registry's flat JSON snapshot
 - ``/trace``    — current span ring buffer as Chrome-trace JSON
+- ``/healthz``  — watchdog verdicts + uptime (ISSUE 5); HTTP 200 while
+  healthy, 503 on a non-finite or anomaly-storm verdict so a fleet
+  health checker needs no JSON parsing
 
 Binds ``DS_METRICS_ADDR`` (default 127.0.0.1).  Port 0 picks an
 ephemeral port (tests); the bound port is on the returned server.
@@ -40,6 +43,17 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 "traceEvents": get_tracer().chrome_events(),
                 "displayTimeUnit": "ms"}).encode()
             ctype = "application/json"
+        elif path == "/healthz":
+            from .watchdog import get_watchdog
+            health = get_watchdog().health()
+            body = json.dumps(health).encode()
+            ctype = "application/json"
+            self.send_response(200 if health["status"] == "ok" else 503)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         else:
             self.send_error(404)
             return

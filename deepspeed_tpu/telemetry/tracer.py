@@ -51,7 +51,9 @@ class SpanTracer:
         self._buf: List[Optional[Record]] = [None] * self._cap
         self._n = 0          # total records ever written
         self.step = 0        # current step label (set_step)
-        self._lock = threading.Lock()
+        # RLock: the postmortem SIGTERM handler dumps the ring on the
+        # main thread and may interrupt a record() holding this lock
+        self._lock = threading.RLock()
 
     def set_step(self, step: int) -> None:
         self.step = step

@@ -1,0 +1,594 @@
+"""Health watchdog + flight recorder (ISSUE 5).
+
+Covers the tentpole — non-finite sentinel on a REAL fp32 train loop fed
+a NaN batch, the EWMA step-time anomaly detector (counter, warn-once
+per storm, trace artifact), goodput accounting, serving step-cache
+hit/miss/compile-on-path counters on a deliberately un-precompiled
+bucket, the postmortem bundle (five artifacts, all loadable, written
+automatically when an exception escapes ``train_batch`` / the FastGen
+step loop), the ``/healthz`` endpoint — plus the satellites: the
+monitor-write drop counter, the ``DS_POSTMORTEM_ON_EXIT`` handler, the
+``tools/check_bench.py`` regression gate, and the disabled-path
+overhead bound for every new instrumentation site.
+"""
+
+import json
+import math
+import os
+import signal
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry import (get_flight_recorder, get_registry,
+                                     get_tracer, get_watchdog,
+                                     trace_span)
+from deepspeed_tpu.telemetry import metrics as tm
+
+BUNDLE = {"registry.json", "trace.json", "config.json", "events.json",
+          "env.json"}
+
+
+@pytest.fixture(autouse=True)
+def _watchdog_hygiene():
+    """Every test starts disabled with clean watchdog/recorder state and
+    default thresholds; the registry is zeroed after."""
+    wd = get_watchdog()
+    rec = get_flight_recorder()
+    saved = (wd.enabled, wd.threshold, wd.warmup, wd.postmortem_dir,
+             rec.postmortem_dir)
+    telemetry.disable()
+    get_tracer().clear()
+    wd.reset()
+    rec.clear()
+    rec._crash_dumped = False
+    yield
+    telemetry.disable()
+    (wd.enabled, wd.threshold, wd.warmup, wd.postmortem_dir,
+     rec.postmortem_dir) = saved
+    wd.reset()
+    rec.clear()
+    rec._crash_dumped = False
+    get_tracer().clear()
+    get_registry().reset()
+
+
+@pytest.fixture
+def warn_log(monkeypatch):
+    """Captured logger.warning calls, rendered to strings."""
+    calls = []
+    from deepspeed_tpu.utils.logging import logger
+
+    def capture(fmt, *args, **kw):
+        try:
+            calls.append(str(fmt) % args if args else str(fmt))
+        except TypeError:
+            calls.append(str(fmt))
+    monkeypatch.setattr(logger, "warning", capture)
+    return calls
+
+
+@pytest.fixture(scope="module")
+def train_engine():
+    import deepspeed_tpu as dst
+    from deepspeed_tpu.models.base import SimpleModel
+    engine, _, _, _ = dst.initialize(
+        model=SimpleModel(32),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 10 ** 9,
+        })
+    return engine
+
+
+def _train_batch_arrays(engine, fill=None):
+    gbs = (engine.train_micro_batch_size_per_gpu()
+           * engine.topology.batch_shard_size)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(gbs, 32)).astype(np.float32)
+    if fill is not None:
+        x[:] = fill
+    return {"x": x,
+            "y": rng.normal(size=(gbs, 32)).astype(np.float32)}
+
+
+@pytest.fixture(scope="module")
+def serving_engine():
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            KVCacheConfig,
+                                            RaggedInferenceEngineConfig,
+                                            RaggedInferenceModel,
+                                            StateManagerConfig)
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+    from flax.core import meta
+    model_def = LlamaForCausalLM("debug", max_seq_len=128,
+                                 dtype=jnp.float32)
+    params = meta.unbox(model_def.init_params(jax.random.key(0)))
+    cfg = model_def.cfg
+    kv_cfg = KVCacheConfig(num_layers=cfg.num_layers,
+                           kv_heads=cfg.kv_heads,
+                           head_dim=cfg.dims_per_head, page_size=16,
+                           num_pages=64, dtype=jnp.float32)
+    econf = RaggedInferenceEngineConfig(
+        state_manager=StateManagerConfig(max_tracked_sequences=8,
+                                         max_ragged_sequence_count=8,
+                                         max_ragged_batch_size=128))
+    return InferenceEngineV2(
+        RaggedInferenceModel(cfg, params, kv_config=kv_cfg), econf)
+
+
+# ---------------------------------------------------------------------------
+# non-finite sentinel on a real train loop
+# ---------------------------------------------------------------------------
+
+class TestNonFiniteSentinel:
+    def test_nan_batch_fires_sentinel_warn_once(self, train_engine,
+                                                warn_log):
+        telemetry.enable()
+        nan_batch = _train_batch_arrays(train_engine, fill=np.nan)
+        base = tm.TRAIN_NONFINITE.value
+        loss = train_engine.train_batch(nan_batch)
+        assert math.isnan(loss)
+        # loss AND grad_norm both came back non-finite (host-fetched)
+        assert tm.TRAIN_NONFINITE.value >= base + 2
+        first = [w for w in warn_log if "non-finite" in w]
+        assert first, f"no non-finite warning in {warn_log}"
+        # second NaN batch: counters grow, no new warnings (warn-once)
+        n_warn = len([w for w in warn_log if "non-finite" in w])
+        after = tm.TRAIN_NONFINITE.value
+        train_engine.train_batch(nan_batch)
+        assert tm.TRAIN_NONFINITE.value >= after + 2
+        assert len([w for w in warn_log if "non-finite" in w]) == n_warn
+        # flight recorder saw the verdicts
+        kinds = {e["kind"] for e in get_flight_recorder().events()}
+        assert "watchdog.nonfinite" in kinds
+        # healthz verdict degrades
+        assert get_watchdog().health()["status"] == "nonfinite"
+
+    def test_goodput_gauges_fed_from_train_phases(self, train_engine):
+        telemetry.enable()
+        get_watchdog().reset()
+        batch = _train_batch_arrays(train_engine)
+        for _ in range(2):
+            train_engine.train_batch(batch)
+        snap = get_registry().snapshot()
+        # the engine is past step 0 so the steps bill the step phase
+        assert snap["ds_train_goodput_ratio"] > 0.0
+        # both read the step phase; the wall-clock denominator advances
+        # between the two snapshot reads, so compare approximately
+        assert snap["ds_train_goodput_ratio"] == pytest.approx(
+            snap["ds_train_step_fraction"], rel=0.05)
+        fracs = [snap[f"ds_train_{p}_fraction"] for p in
+                 ("compile", "input_wait", "step", "checkpoint", "idle")]
+        assert all(0.0 <= f <= 1.0 for f in fracs)
+        assert sum(fracs) == pytest.approx(1.0, abs=0.05)
+
+    def test_handled_fp16_overflow_is_not_nonfinite(self):
+        """A routine fp16 dynamic-loss-scale overflow (overflow IS
+        ~isfinite(gnorm)) feeds only the skip counter — the non-finite
+        verdict is reserved for applied steps, so /healthz never 503s a
+        healthy loss-scaling run."""
+        import deepspeed_tpu as dst
+        from deepspeed_tpu.models.base import SimpleModel
+        engine, _, _, _ = dst.initialize(
+            model=SimpleModel(16),
+            config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 0},
+                "fp16": {"enabled": True},
+                "steps_per_print": 10 ** 9,
+            })
+        telemetry.enable()
+        gbs = 2 * engine.topology.batch_shard_size
+        inf_batch = {"x": np.full((gbs, 16), np.inf, np.float32),
+                     "y": np.zeros((gbs, 16), np.float32)}
+        scale_before = engine.loss_scale
+        engine.train_batch(inf_batch)
+        assert engine.skipped_steps == 1
+        assert engine.loss_scale <= scale_before
+        assert tm.TRAIN_OVERFLOW_SKIP.value == 1
+        assert tm.TRAIN_NONFINITE.value == 0
+        assert get_watchdog().health()["status"] == "ok"
+
+    def test_nonfinite_verdict_heals_after_calm_steps(self):
+        """The /healthz verdict is recency-based: finite train steps
+        clear it (the cumulative counter keeps the history)."""
+        telemetry.enable()
+        wd = get_watchdog()
+        wd.note_nonfinite("loss", 3, float("nan"))
+        assert wd.health()["status"] == "nonfinite"
+        for i in range(wd.calm_steps + 1):
+            wd.observe_step_time("train", 10.0, step=4 + i)
+        assert wd.health()["status"] == "ok"
+        assert tm.TRAIN_NONFINITE.value == 1   # history preserved
+
+    def test_disabled_train_loop_records_nothing(self, train_engine):
+        assert not telemetry.enabled()
+        base = tm.TRAIN_NONFINITE.value
+        train_engine.train_batch(
+            _train_batch_arrays(train_engine, fill=np.nan))
+        assert tm.TRAIN_NONFINITE.value == base
+        assert get_flight_recorder().events() == []
+
+
+# ---------------------------------------------------------------------------
+# EWMA step-time anomaly detector
+# ---------------------------------------------------------------------------
+
+class TestAnomalyDetector:
+    def test_slow_step_flagged_warn_once_and_trace_dumped(
+            self, tmp_path, warn_log):
+        telemetry.enable()
+        wd = get_watchdog()
+        wd.postmortem_dir = str(tmp_path)
+        with trace_span("anomaly.filler"):
+            pass
+        for i in range(wd.warmup + 2):
+            wd.observe_step_time("train", 10.0, step=i)
+        base = tm.TRAIN_ANOMALY.value
+        wd.observe_step_time("train", 200.0, step=99)
+        assert tm.TRAIN_ANOMALY.value == base + 1
+        storms = [w for w in warn_log if "anomaly storm" in w]
+        assert len(storms) == 1 and "train" in storms[0]
+        trace_path = tmp_path / "anomaly_train_step99.json"
+        assert trace_path.exists()
+        doc = json.load(open(trace_path))
+        assert any(e["name"] == "anomaly.filler"
+                   for e in doc["traceEvents"])
+        # further anomalies in the same storm: counted, not re-warned
+        wd.observe_step_time("train", 300.0, step=100)
+        assert tm.TRAIN_ANOMALY.value == base + 2
+        assert len([w for w in warn_log if "anomaly storm" in w]) == 1
+        assert wd.health()["status"] == "anomaly"
+        # calm steps end the storm; the next spike warns again
+        for i in range(wd.calm_steps):
+            wd.observe_step_time("train", 10.0, step=101 + i)
+        assert wd.health()["status"] == "ok"
+        wd.observe_step_time("train", 200.0, step=200)
+        assert len([w for w in warn_log if "anomaly storm" in w]) == 2
+
+    def test_anomalous_samples_do_not_move_the_ewma(self):
+        telemetry.enable()
+        wd = get_watchdog()
+        for i in range(wd.warmup + 2):
+            wd.observe_step_time("fastgen", 10.0, step=i)
+        mean_before = wd._kinds["fastgen"].mean_ms
+        wd.observe_step_time("fastgen", 500.0, step=50)
+        assert wd._kinds["fastgen"].mean_ms == mean_before
+
+    def test_no_verdicts_during_warmup(self):
+        telemetry.enable()
+        wd = get_watchdog()
+        base = tm.TRAIN_ANOMALY.value
+        wd.observe_step_time("train", 10.0, step=0)
+        wd.observe_step_time("train", 500.0, step=1)  # warmup: ignored
+        assert tm.TRAIN_ANOMALY.value == base
+
+
+# ---------------------------------------------------------------------------
+# serving step-cache / recompile accounting
+# ---------------------------------------------------------------------------
+
+class TestStepCacheAccounting:
+    def test_unprecompiled_bucket_counts_miss_then_hit(
+            self, serving_engine):
+        for c in (tm.FASTGEN_STEP_CACHE_HIT, tm.FASTGEN_STEP_CACHE_MISS,
+                  tm.FASTGEN_COMPILE_ON_PATH):
+            c.reset()
+        serving_engine.put([501], [np.arange(4, dtype=np.int32)])
+        # nothing was precompiled: the first put compiles on-path
+        assert tm.FASTGEN_STEP_CACHE_MISS.value == 1
+        assert tm.FASTGEN_COMPILE_ON_PATH.value == 1
+        serving_engine.flush(501)
+        # identical bucket again: pure cache hit, no new compile
+        serving_engine.put([502], [np.arange(4, dtype=np.int32)])
+        assert tm.FASTGEN_STEP_CACHE_HIT.value == 1
+        assert tm.FASTGEN_STEP_CACHE_MISS.value == 1
+        assert tm.FASTGEN_COMPILE_ON_PATH.value == 1
+        serving_engine.flush(502)
+        health = get_watchdog().health()["step_cache"]
+        assert health["miss_total"] == 1 and health["hit_total"] == 1
+
+    def test_strict_miss_counts_without_compiling(self, serving_engine):
+        model = serving_engine.model
+        for c in (tm.FASTGEN_STEP_CACHE_MISS,
+                  tm.FASTGEN_COMPILE_ON_PATH):
+            c.reset()
+        model.strict_shapes = True
+        try:
+            with pytest.raises(RuntimeError, match="not precompiled"):
+                serving_engine.put([503],
+                                   [np.arange(16, dtype=np.int32)])
+        finally:
+            model.strict_shapes = False
+            serving_engine.flush(503)
+        assert tm.FASTGEN_STEP_CACHE_MISS.value == 1
+        assert tm.FASTGEN_COMPILE_ON_PATH.value == 0
+
+    def test_recompile_storm_warns_once_naming_keys(self, warn_log):
+        wd = get_watchdog()
+        key = (8, 1, 8, False, "sample", True)
+        for _ in range(wd.storm_compiles):
+            wd.note_step_cache(hit=False, key=key,
+                               compiled_on_path=True)
+        storms = [w for w in warn_log if "recompile storm" in w]
+        assert len(storms) == 1
+        assert repr(key) in storms[0] or str(key) in storms[0]
+        # still inside the same storm: no second warning
+        wd.note_step_cache(hit=False, key=key, compiled_on_path=True)
+        assert len([w for w in warn_log if "recompile storm" in w]) == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: bundle schema + automatic crash invocation
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_postmortem_bundle_schema(self, tmp_path):
+        telemetry.enable()
+        rec = get_flight_recorder()
+        rec.record("unit.test", detail="schema")
+        with trace_span("pm.span"):
+            pass
+        out = str(tmp_path / "pm")
+        paths = telemetry.dump_postmortem(out)
+        assert set(paths) == BUNDLE
+        docs = {name: json.load(open(p)) for name, p in paths.items()}
+        # registry snapshot: the full minted namespace, flat
+        assert "ds_serving_steps_total" in docs["registry.json"]
+        assert "ds_train_nonfinite_total" in docs["registry.json"]
+        # chrome trace loads and holds the span
+        assert any(e["name"] == "pm.span"
+                   for e in docs["trace.json"]["traceEvents"])
+        # event log holds the recorded event with its schema
+        evts = docs["events.json"]["events"]
+        mine = [e for e in evts if e["kind"] == "unit.test"]
+        assert mine and mine[0]["detail"] == "schema"
+        assert {"ts", "kind", "step"} <= set(mine[0])
+        # env capture: process identity + health verdict, no backend touch
+        env = docs["env.json"]
+        assert env["pid"] == os.getpid()
+        assert env["health"]["status"] in ("ok", "anomaly", "nonfinite")
+        assert isinstance(docs["config.json"], dict)
+
+    def test_event_ring_is_bounded(self):
+        telemetry.enable()
+        rec = get_flight_recorder()
+        rec.resize(16)
+        try:
+            for i in range(50):
+                rec.record("flood", i=i)
+            evts = rec.events()
+            assert len(evts) == 16
+            assert evts[-1]["i"] == 49 and evts[0]["i"] == 34
+        finally:
+            rec.resize(1024)
+
+    def test_crash_escaping_train_batch_dumps_bundle(self, train_engine,
+                                                     tmp_path):
+        telemetry.enable()
+        rec = get_flight_recorder()
+        rec.postmortem_dir = str(tmp_path / "crash")
+        bad = {"x": np.zeros((3, 32), np.float32),
+               "y": np.zeros((3, 32), np.float32)}  # indivisible batch
+        with pytest.raises(ValueError):
+            train_engine.train_batch(bad)
+        bundle_dir = tmp_path / "crash"
+        assert {p.name for p in bundle_dir.iterdir()} >= BUNDLE
+        evts = json.load(open(bundle_dir / "events.json"))["events"]
+        crash = [e for e in evts if e["kind"] == "crash"]
+        assert crash and crash[0]["where"] == "train_batch"
+        assert crash[0]["exc_type"] == "ValueError"
+        # engine configs were captured at build time
+        cfg = json.load(open(bundle_dir / "config.json"))
+        assert "runtime" in cfg
+
+    def test_crash_escaping_fastgen_step_dumps_bundle(
+            self, serving_engine, tmp_path, monkeypatch):
+        from deepspeed_tpu.inference.v2 import FastGenScheduler
+        telemetry.enable()
+        rec = get_flight_recorder()
+        rec.postmortem_dir = str(tmp_path / "fg")
+        sched = FastGenScheduler(serving_engine)
+        monkeypatch.setattr(
+            sched, "_step_impl",
+            lambda on_token: (_ for _ in ()).throw(
+                RuntimeError("injected step failure")))
+        with pytest.raises(RuntimeError, match="injected step failure"):
+            sched.step()
+        assert {p.name
+                for p in (tmp_path / "fg").iterdir()} >= BUNDLE
+        evts = json.load(open(tmp_path / "fg" / "events.json"))["events"]
+        assert any(e["kind"] == "crash"
+                   and e["where"] == "fastgen.step" for e in evts)
+        # second crash in the same process records but does not re-dump
+        assert rec._crash_dumped
+
+    def test_scheduler_lifecycle_events_recorded(self, serving_engine):
+        from deepspeed_tpu.inference.v2 import (FastGenScheduler,
+                                                SamplingParams)
+        telemetry.enable()
+        rec = get_flight_recorder()
+        rec.clear()
+        sched = FastGenScheduler(serving_engine)
+        sched.submit(601, list(range(8)),
+                     SamplingParams(max_new_tokens=2, temperature=0.0))
+        sched.run_to_completion()
+        kinds = [e["kind"] for e in rec.events()]
+        assert "request.admit" in kinds
+        assert "request.done" in kinds
+
+
+# ---------------------------------------------------------------------------
+# /healthz endpoint
+# ---------------------------------------------------------------------------
+
+def test_healthz_endpoint_serves_verdicts():
+    from deepspeed_tpu.telemetry import (start_http_server,
+                                         stop_http_server)
+    telemetry.enable()
+    srv = start_http_server(0)
+    try:
+        port = srv.server_address[1]
+        url = f"http://127.0.0.1:{port}/healthz"
+        body = json.loads(urllib.request.urlopen(url).read())
+        assert body["status"] == "ok"
+        assert body["uptime_s"] > 0
+        assert body["telemetry_enabled"] is True
+        assert "goodput" in body and "step_cache" in body
+        # an unhealthy verdict flips the HTTP status to 503
+        get_watchdog().note_nonfinite("loss", 0, float("nan"))
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(url)
+        assert exc_info.value.code == 503
+        assert json.loads(
+            exc_info.value.read())["status"] == "nonfinite"
+    finally:
+        stop_http_server()
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+def test_monitor_write_drop_counter_and_warn_once(train_engine,
+                                                  warn_log):
+    def boom(*args):
+        raise OSError("disk full")
+    train_engine._monitor_write_warned = False
+    base = tm.TRAIN_MONITOR_DROP.value
+    train_engine._monitor_write(boom, [])
+    train_engine._monitor_write(boom, [])
+    assert tm.TRAIN_MONITOR_DROP.value == base + 2
+    drops = [w for w in warn_log if "monitor write failed" in w]
+    assert len(drops) == 1 and "OSError" in drops[0]
+
+
+def test_exit_handlers_install_and_dump_idempotently(tmp_path,
+                                                     monkeypatch):
+    import deepspeed_tpu.telemetry.flight_recorder as fr
+    rec = fr.get_flight_recorder()
+    monkeypatch.setenv("DS_POSTMORTEM_ON_EXIT", "0")
+    monkeypatch.setattr(fr, "_handlers_installed", False)
+    assert not fr.maybe_install_exit_handlers()   # opt-in respected
+    monkeypatch.setenv("DS_POSTMORTEM_ON_EXIT", "1")
+    prev_sig = signal.getsignal(signal.SIGTERM)
+    try:
+        assert fr.maybe_install_exit_handlers()
+        assert signal.getsignal(signal.SIGTERM) is not prev_sig
+        rec.postmortem_dir = str(tmp_path / "exitpm")
+        rec._exit_dumped = False
+        rec.dump_on_exit(signum=signal.SIGTERM)
+        bundle = tmp_path / "exitpm"
+        assert {p.name for p in bundle.iterdir()} >= BUNDLE
+        mtime = (bundle / "registry.json").stat().st_mtime_ns
+        # idempotent: a second delivery (atexit after SIGTERM) is a
+        # no-op, and never raises even with an unwritable dir
+        rec.postmortem_dir = "/proc/definitely/not/writable"
+        rec.dump_on_exit()
+        assert (bundle / "registry.json").stat().st_mtime_ns == mtime
+    finally:
+        signal.signal(signal.SIGTERM, prev_sig)
+        rec._exit_dumped = True   # keep the registered atexit a no-op
+
+
+def test_telemetry_config_block_configures_watchdog():
+    from deepspeed_tpu.runtime.config import load_config
+    wd = get_watchdog()
+    rec = get_flight_recorder()
+    cfg = load_config({"telemetry": {
+        "watchdog_threshold": 5.0, "watchdog_warmup": 3,
+        "postmortem_dir": "/tmp/ds-pm-test",
+        "flight_recorder_events": 64}})
+    try:
+        cfg.telemetry.apply()
+        assert wd.threshold == 5.0 and wd.warmup == 3
+        assert wd.postmortem_dir == "/tmp/ds-pm-test"
+        assert rec.postmortem_dir == "/tmp/ds-pm-test"
+        assert rec._events.maxlen == 64
+        # keep-current convention: an empty block changes nothing
+        load_config({}).telemetry.apply()
+        assert wd.threshold == 5.0 and wd.warmup == 3
+        # watchdog off: verdict entry points become no-ops
+        load_config({"telemetry": {"watchdog": False}}).telemetry.apply()
+        telemetry.enable()
+        base = tm.TRAIN_ANOMALY.value
+        for i in range(20):
+            wd.observe_step_time("train", 10.0 if i < 19 else 500.0)
+        assert tm.TRAIN_ANOMALY.value == base
+    finally:
+        rec.resize(1024)
+        wd.configure(enabled=True, threshold=3.0, warmup=8)
+
+
+def test_check_bench_gate(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import check_bench
+
+    def write(n, parsed):
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"parsed": parsed}))
+
+    write(1, {"value": 100.0, "fastgen_decode_tok_s": 400.0,
+              "fastgen_ttft_p50_ms": 30.0})
+    write(2, {"value": 95.0, "fastgen_decode_tok_s": 390.0,
+              "fastgen_ttft_p50_ms": 33.0})
+    # within tolerances: clean under --strict
+    assert check_bench.main(["--dir", str(tmp_path), "--strict"]) == 0
+    # throughput drop >10% and latency growth >15%: warn-only passes,
+    # --strict fails
+    write(3, {"value": 80.0, "fastgen_decode_tok_s": 390.0,
+              "fastgen_ttft_p50_ms": 40.0})
+    assert check_bench.main(["--dir", str(tmp_path)]) == 0
+    assert check_bench.main(["--dir", str(tmp_path), "--strict"]) == 1
+    # a failed round (parsed: null) is skipped as the comparison base
+    write(4, None)
+    write(5, {"value": 81.0, "fastgen_ttft_p50_ms": 41.0})
+    assert check_bench.main(["--dir", str(tmp_path), "--strict"]) == 0
+    # cross-backend rounds downgrade regressions to notes
+    write(6, {"value": 30.0, "cpu_fallback": True,
+              "fastgen_ttft_p50_ms": 300.0})
+    assert check_bench.main(["--dir", str(tmp_path), "--strict"]) == 0
+    # classification: totals/compile_s/error keys are never gated
+    assert check_bench.classify("fastgen_step_cache_miss_total") is None
+    assert check_bench.classify("fastgen_compile_s") is None
+    assert check_bench.classify("train_goodput_ratio") == "throughput"
+    assert check_bench.classify("fastgen_step_p99_ms") == "latency"
+
+
+def test_disabled_path_overhead_for_new_sites():
+    """Watchdog + flight-recorder entry points keep the spine's
+    disabled-path bound (<5µs/site, generous CI-noise margin)."""
+    assert not telemetry.enabled()
+    wd = get_watchdog()
+    rec = get_flight_recorder()
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with wd.track("step"):
+            pass
+    per = (time.perf_counter() - t0) / n
+    assert per < 5e-6, f"track: {per * 1e6:.2f}us disabled"
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rec.record("hot")
+    per = (time.perf_counter() - t0) / n
+    assert per < 5e-6, f"record: {per * 1e6:.2f}us disabled"
+    t0 = time.perf_counter()
+    for _ in range(n):
+        wd.observe_step_time("train", 1.0)
+    per = (time.perf_counter() - t0) / n
+    assert per < 5e-6, f"observe: {per * 1e6:.2f}us disabled"
+    assert rec.events() == []
+    assert tm.TRAIN_ANOMALY.value == 0

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Bench regression gate (ISSUE 5 satellite).
+
+Compares the latest ``BENCH_r*.json`` artifact's ``parsed`` metrics
+against the previous round with per-metric-class tolerances:
+
+- **throughput** keys (``value``, ``*_tok_s``, ``*_req_s``,
+  ``*_hit_rate``, ``*goodput*``) may not DROP more than 10%;
+- **latency / SLO** keys (``*_ms`` — p50/p99 TTFT, ITL, queue wait,
+  step time) may not GROW more than 15%.
+
+Warn-only by default (CPU bench numbers carry ±20% run-to-run noise and
+a TPU→CPU-fallback round is not a regression); ``--strict`` exits
+non-zero for CI.  Rounds measured on different backends (one
+``cpu_fallback``, one not) are compared but every finding is
+downgraded to a cross-backend note.
+
+Usage::
+
+    python tools/check_bench.py            # warn-only, repo root
+    python tools/check_bench.py --strict   # non-zero exit on regression
+    python tools/check_bench.py --dir /path/to/artifacts
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+THROUGHPUT_DROP_TOL = 0.10   # throughput may not drop >10%
+LATENCY_GROW_TOL = 0.15      # SLO latencies may not grow >15%
+
+_THROUGHPUT_RE = re.compile(
+    r"(^value$|_tok_s$|_req_s$|_hit_rate$|goodput)")
+_LATENCY_RE = re.compile(r"_ms$")
+#: parsed keys that are not a measured quantity at all
+_SKIP_RE = re.compile(
+    r"(^metric$|^unit$|error|^cpu_fallback$|_model$|_path$|_policy$|"
+    r"^micro_bs$|estimated|^swept|^vs_baseline$|_total$|compile_s$)")
+
+
+def _round_files(art_dir: str) -> List[str]:
+    files = glob.glob(os.path.join(art_dir, "BENCH_r*.json"))
+
+    def round_no(path):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+    return sorted((f for f in files if round_no(f) >= 0), key=round_no)
+
+
+def _load_parsed(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_bench: cannot read {path}: {e}", file=sys.stderr)
+        return None
+    parsed = doc.get("parsed")
+    return parsed if isinstance(parsed, dict) else None
+
+
+def classify(key: str) -> Optional[str]:
+    """'throughput' | 'latency' | None (ignored)."""
+    if _SKIP_RE.search(key):
+        return None
+    if _THROUGHPUT_RE.search(key):
+        return "throughput"
+    if _LATENCY_RE.search(key):
+        return "latency"
+    return None
+
+
+def compare(prev: Dict, cur: Dict) -> List[Tuple[str, str]]:
+    """Return [(severity, message)]; severity is 'regression' or
+    'note'."""
+    findings: List[Tuple[str, str]] = []
+    cross_backend = bool(prev.get("cpu_fallback")) != bool(
+        cur.get("cpu_fallback"))
+    if cross_backend:
+        findings.append((
+            "note",
+            "backends differ between rounds (cpu_fallback flag flipped) "
+            "— deltas below are cross-backend notes, not regressions"))
+    for key in sorted(set(prev) & set(cur)):
+        kind = classify(key)
+        if kind is None:
+            continue
+        p, c = prev[key], cur[key]
+        if not (isinstance(p, (int, float)) and isinstance(c, (int, float))):
+            continue
+        if p <= 0:
+            continue    # nothing to ratio against
+        rel = (c - p) / p
+        if kind == "throughput" and rel < -THROUGHPUT_DROP_TOL:
+            findings.append((
+                "note" if cross_backend else "regression",
+                f"{key}: {p} -> {c} ({rel * 100:+.1f}%; throughput "
+                f"tolerance -{THROUGHPUT_DROP_TOL * 100:.0f}%)"))
+        elif kind == "latency" and rel > LATENCY_GROW_TOL:
+            findings.append((
+                "note" if cross_backend else "regression",
+                f"{key}: {p} -> {c} ({rel * 100:+.1f}%; latency "
+                f"tolerance +{LATENCY_GROW_TOL * 100:.0f}%)"))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=REPO_ROOT,
+                    help="directory holding BENCH_r*.json artifacts")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on a regression (CI mode)")
+    args = ap.parse_args(argv)
+
+    rounds = _round_files(args.dir)
+    if len(rounds) < 2:
+        print(f"check_bench: need >= 2 BENCH_r*.json rounds under "
+              f"{args.dir} ({len(rounds)} found) — nothing to compare")
+        return 0
+    cur_path = rounds[-1]
+    cur = _load_parsed(cur_path)
+    if cur is None:
+        print(f"check_bench: latest round {os.path.basename(cur_path)} "
+              "has no usable 'parsed' metrics — skipping comparison")
+        return 0
+    # the previous round may have failed outright (parsed: null) — walk
+    # back to the most recent round that actually measured something
+    prev_path, prev = None, None
+    for cand in reversed(rounds[:-1]):
+        prev = _load_parsed(cand)
+        if prev is not None:
+            prev_path = cand
+            break
+    if prev is None:
+        print("check_bench: no earlier round with usable 'parsed' "
+              "metrics — nothing to compare")
+        return 0
+
+    findings = compare(prev, cur)
+    regressions = [m for sev, m in findings if sev == "regression"]
+    notes = [m for sev, m in findings if sev == "note"]
+    label = (f"{os.path.basename(prev_path)} -> "
+             f"{os.path.basename(cur_path)}")
+    for m in notes:
+        print(f"check_bench [note] {label}: {m}")
+    for m in regressions:
+        print(f"check_bench [REGRESSION] {label}: {m}",
+              file=sys.stderr)
+    if not findings:
+        print(f"check_bench: {label}: no regressions within tolerances")
+    if regressions and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
