@@ -383,6 +383,58 @@ def bench_fastgen(jax):
             except Exception as e:  # noqa: BLE001
                 sys.stderr.write(f"bench: fastgen SLO leg failed: {e}\n")
                 result["fastgen_slo_error"] = str(e)[:300]
+        if os.environ.get("BENCH_CHAOS", "0") != "0":
+            # chaos leg (ISSUE 7): the same workload under a ~10%
+            # injected-fault rate (poisoned requests + KV-allocator
+            # OOM), with graceful degradation on — measures how much
+            # decode throughput survives and what fraction of requests
+            # the degradation ladder sheds.  Off by default so headline
+            # legs stay comparable; its own try like the SLO leg.
+            from deepspeed_tpu.runtime.fault_injection import \
+                get_fault_injector
+            try:
+                from deepspeed_tpu.telemetry import metrics as tmet
+                chaos_serving = ServingOptimizationConfig(
+                    prefix_caching=False, shed_unservable=True)
+                run(range(n_req), serving=chaos_serving)  # warm shapes
+                fi = get_fault_injector()
+                err0 = (tmet.FASTGEN_SHED.value
+                        + tmet.FASTGEN_EXPIRED.value
+                        + tmet.FASTGEN_REQUEST_ERROR.value)
+                inj0 = tmet.CHAOS_INJECTED.value
+                # the poison site is probed at EVERY per-step admission
+                # of a request (and steady-state async decode chains
+                # past admission entirely), so a bare probability both
+                # compounds per token on host-path steps and misses on
+                # chained ones.  Deterministic instead: poison ~10% of
+                # requests at evenly-spaced admission ordinals of the
+                # initial wave, plus a bounded dose of allocator OOMs.
+                budget = max(1, round(0.1 * n_req))
+                poison_at = [round((i + 0.5) * n_req / budget)
+                             for i in range(budget)]
+                fi.configure({
+                    "fastgen.poison_request": {"at_calls": poison_at},
+                    "kv.alloc_oom": {"p": 0.2, "max_fires": budget},
+                }, seed=int(os.environ.get("BENCH_CHAOS_SEED", "0")))
+                try:
+                    c_total, _, c_done = run(range(n_req),
+                                             serving=chaos_serving)
+                finally:
+                    fi.disarm()
+                errs = (tmet.FASTGEN_SHED.value
+                        + tmet.FASTGEN_EXPIRED.value
+                        + tmet.FASTGEN_REQUEST_ERROR.value) - err0
+                result["fastgen_chaos_decode_tok_s"] = round(
+                    c_done / c_total, 1)
+                result["fastgen_chaos_shed_rate"] = round(
+                    errs / n_req, 3)
+                result["fastgen_chaos_injected_total"] = \
+                    tmet.CHAOS_INJECTED.value - inj0
+            except Exception as e:  # noqa: BLE001
+                get_fault_injector().disarm()
+                sys.stderr.write(f"bench: fastgen chaos leg failed: "
+                                 f"{e}\n")
+                result["fastgen_chaos_error"] = str(e)[:300]
         return result
     except Exception as e:  # noqa: BLE001 — aux leg must not kill the bench
         sys.stderr.write(f"bench: fastgen leg failed: {e}\n")

@@ -7,6 +7,13 @@ checkpoint + a ``latest`` file preserve the reference's on-disk contract;
 *universal checkpointing* (reference ``deepspeed/checkpoint/``) is native
 here — Orbax restores into any sharding/topology, so reshaping across
 (dp, tp, pp) changes requires no offline atom-file conversion.
+
+Durability contract (ISSUE 7): ``latest`` is written ATOMICALLY (tmp +
+fsync + rename) and LAST, so a crash or SIGTERM at any point mid-save
+leaves ``latest`` pointing at the previous complete checkpoint — never
+at a partial one.  Transient I/O errors (``OSError``, including the
+``ckpt.io_error`` injection site) are retried with exponential backoff
+and counted in ``ds_train_ckpt_retry_total``.
 """
 
 from __future__ import annotations
@@ -14,17 +21,37 @@ from __future__ import annotations
 import abc
 import json
 import os
-from typing import Any, Optional, Tuple
+import time
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import numpy as np
 
+from ..runtime.fault_injection import (InjectedCheckpointFault,
+                                       get_fault_injector)
+from ..telemetry import metrics as tm
 from ..utils.logging import logger
 
 LATEST_FILE = "latest"
 
 
+def _atomic_write_text(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` atomically: tmp file in the same
+    directory, fsync, rename.  A reader never observes a torn write; a
+    crash leaves at worst a stale ``<path>.tmp.<pid>``."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 class CheckpointEngine(abc.ABC):
+    #: transient-I/O retry policy (overridden from CheckpointConfig)
+    save_retries: int = 3
+    save_backoff_s: float = 0.05
+
     @abc.abstractmethod
     def save(self, save_dir: str, tag: str, state: Any, client_state: dict) -> None:
         ...
@@ -34,17 +61,51 @@ class CheckpointEngine(abc.ABC):
              shardings: Any, module_only: bool = False) -> Tuple[Any, dict]:
         ...
 
+    def wait(self) -> None:
+        """Block until any in-flight async save is fully persisted
+        (no-op for synchronous engines).  Must be called before
+        publishing a pointer (``latest``) to the saved tag."""
+
+    def _with_retries(self, what: str, fn: Callable[[], Any]) -> Any:
+        """Run ``fn``, retrying ``OSError`` up to ``save_retries`` times
+        with exponential backoff.  Non-I/O failures propagate
+        immediately (they are bugs, not weather)."""
+        delay = self.save_backoff_s
+        for attempt in range(self.save_retries + 1):
+            try:
+                return fn()
+            except OSError as e:
+                if attempt >= self.save_retries:
+                    raise
+                tm.TRAIN_CKPT_RETRY.inc()
+                logger.warning(
+                    "checkpoint %s failed (%s: %s) — retry %d/%d in "
+                    "%.2fs", what, type(e).__name__, e, attempt + 1,
+                    self.save_retries, delay)
+                time.sleep(delay)
+                delay *= 2
+
     def write_latest(self, save_dir: str, tag: str) -> None:
         if jax.process_index() == 0:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(tag)
+            path = os.path.join(save_dir, LATEST_FILE)
+
+            def _write():
+                get_fault_injector().maybe_raise(
+                    "ckpt.io_error", InjectedCheckpointFault,
+                    "injected I/O error writing latest")
+                _atomic_write_text(path, tag)
+
+            self._with_retries("write_latest", _write)
 
     def read_latest(self, load_dir: str) -> Optional[str]:
+        # stale ``latest.tmp.<pid>`` files (a writer died pre-rename)
+        # are ignored: only the atomically-renamed file is authoritative
         path = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(path):
             return None
         with open(path) as f:
-            return f.read().strip()
+            tag = f.read().strip()
+        return tag or None
 
     def commit(self, tag: str) -> bool:
         return True
@@ -54,8 +115,11 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     """Async sharded checkpointing via Orbax (the reference's Nebula-style
     async persistence, natively)."""
 
-    def __init__(self, async_save: bool = True):
+    def __init__(self, async_save: bool = True, save_retries: int = 3,
+                 save_backoff_s: float = 0.05):
         self.async_save = async_save
+        self.save_retries = int(save_retries)
+        self.save_backoff_s = float(save_backoff_s)
         self._pending = None  # in-flight AsyncCheckpointer
 
     def _checkpointer(self):
@@ -68,15 +132,31 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         path = os.path.abspath(os.path.join(save_dir, tag))
         os.makedirs(save_dir, exist_ok=True)
         self.wait()  # at most one save in flight
-        ckptr = self._checkpointer()
-        ckptr.save(os.path.join(path, "state"), state, force=True)
-        if self.async_save:
-            # Training continues while serialization drains in background
-            # threads (the reference's Nebula-style async persistence).
-            self._pending = ckptr
+
+        def _save_state():
+            get_fault_injector().maybe_raise(
+                "ckpt.io_error", InjectedCheckpointFault,
+                "injected I/O error saving checkpoint state")
+            ckptr = self._checkpointer()
+            ckptr.save(os.path.join(path, "state"), state, force=True)
+            if self.async_save:
+                # Training continues while serialization drains in
+                # background threads (the reference's Nebula-style async
+                # persistence).
+                self._pending = ckptr
+
+        self._with_retries("save", _save_state)
         if jax.process_index() == 0:
-            with open(os.path.join(path, "client_state.json"), "w") as f:
-                json.dump(_jsonable(client_state), f)
+            payload = json.dumps(_jsonable(client_state))
+
+            def _save_client():
+                get_fault_injector().maybe_raise(
+                    "ckpt.io_error", InjectedCheckpointFault,
+                    "injected I/O error saving client state")
+                _atomic_write_text(
+                    os.path.join(path, "client_state.json"), payload)
+
+            self._with_retries("client_state", _save_client)
         logger.info("saved checkpoint %s%s", path,
                     " (async)" if self.async_save else "")
 

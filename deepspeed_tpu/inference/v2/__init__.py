@@ -1,4 +1,5 @@
-from .config import (KVCacheUserConfig, RaggedInferenceEngineConfig,
+from .config import (FaultInjectionConfig, KVCacheUserConfig,
+                     RaggedInferenceEngineConfig,
                      ServingOptimizationConfig, StateManagerConfig)
 from .engine import InferenceEngineV2, SchedulingError, SchedulingResult
 from .factory import build_hf_engine
@@ -7,8 +8,9 @@ from .model_implementations import (implementation_for,
                                     supported_model_types)
 from .ragged import (BlockedAllocator, BlockedKVCache, KVCacheConfig,
                      RaggedBatch, StateManager, build_batch)
+from .ragged.blocked_allocator import KVAllocationError
 from .sampling import SamplingParams, sample, sample_dynamic
-from .scheduler import FastGenScheduler, Request, generate
+from .scheduler import FastGenScheduler, Request, RequestError, generate
 
 __all__ = [
     "KVCacheUserConfig", "RaggedInferenceEngineConfig",
@@ -19,5 +21,6 @@ __all__ = [
     "BlockedAllocator", "BlockedKVCache",
     "KVCacheConfig", "RaggedBatch", "StateManager", "build_batch",
     "SamplingParams", "sample", "sample_dynamic",
-    "FastGenScheduler", "Request", "generate",
+    "FastGenScheduler", "Request", "RequestError", "generate",
+    "FaultInjectionConfig", "KVAllocationError",
 ]

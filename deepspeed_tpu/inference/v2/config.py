@@ -57,6 +57,19 @@ class ServingOptimizationConfig:
     #: pressure), so warm-prefix admission only prefills the uncached
     #: suffix.  Off: every request re-prefills its whole prompt (seed)
     prefix_caching: bool = True
+    #: graceful degradation (ISSUE 7), 0/False = seed behavior:
+    #: bounded admission queue — submits past this many pending
+    #: requests are shed with a structured error (0 = unbounded)
+    max_queue_depth: int = 0
+    #: shed new submits while observed queue-wait p90 exceeds this
+    #: (telemetry-fed SLO histogram; 0 = off)
+    shed_queue_wait_ms: float = 0.0
+    #: default per-request TTL seconds; expired requests terminate with
+    #: a structured error instead of hanging (0 = no deadline)
+    default_ttl_s: float = 0.0
+    #: on a would-be scheduler deadlock, shed the most demanding
+    #: request with a structured "oom" error instead of raising
+    shed_unservable: bool = False
 
 
 @dataclasses.dataclass
@@ -89,6 +102,22 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass
+class FaultInjectionConfig:
+    """Serving-side view of the deterministic chaos registry
+    (``runtime/fault_injection.py``), mirroring the runtime config's
+    ``fault_injection`` block.  ``enabled=False`` leaves the process
+    registry alone (a default-config engine build must not disarm a
+    ``DS_CHAOS`` env arming)."""
+    enabled: bool = False
+    seed: int = 0
+    sites: dict = dataclasses.field(default_factory=dict)
+
+    def apply(self) -> None:
+        from ...runtime.fault_injection import apply_fault_injection
+        apply_fault_injection(self.enabled, self.seed, self.sites)
+
+
+@dataclasses.dataclass
 class RaggedInferenceEngineConfig:
     state_manager: StateManagerConfig = dataclasses.field(
         default_factory=StateManagerConfig)
@@ -100,6 +129,8 @@ class RaggedInferenceEngineConfig:
         default_factory=ServingOptimizationConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig)
+    fault_injection: FaultInjectionConfig = dataclasses.field(
+        default_factory=FaultInjectionConfig)
     tp_size: int = 1
 
     @classmethod
@@ -129,5 +160,8 @@ class RaggedInferenceEngineConfig:
         for k, v in d.get("telemetry", {}).items():
             if hasattr(cfg.telemetry, k):
                 setattr(cfg.telemetry, k, v)
+        for k, v in d.get("fault_injection", {}).items():
+            if hasattr(cfg.fault_injection, k):
+                setattr(cfg.fault_injection, k, v)
         cfg.tp_size = d.get("tensor_parallel", {}).get("tp_size", 1)
         return cfg

@@ -87,6 +87,7 @@ class InferenceEngineV2:
             kv_sharding=model.kv_sharding(),
             prefix_caching=self._config.serving.prefix_caching)
         self._config.telemetry.apply()
+        self._config.fault_injection.apply()
         self._bind_kv_gauges()
         # flight recorder (ISSUE 5): capture the serving config + a
         # lifecycle event at engine build

@@ -32,6 +32,13 @@ import numpy as np
 NULL_PAGE = 0
 
 
+class KVAllocationError(ValueError):
+    """KV-page pool cannot satisfy an allocation (real exhaustion or the
+    ``kv.alloc_oom`` injection site).  A ``ValueError`` for backward
+    compatibility; the scheduler catches this type to degrade (evict
+    parked pages, preempt, shed) instead of crashing the step loop."""
+
+
 class BlockedAllocator:
     """O(n)-per-op free-list of KV pages, indices in [1, num_pages]."""
 
@@ -109,7 +116,7 @@ class BlockedAllocator:
 
     def allocate(self, num_pages: int) -> np.ndarray:
         if num_pages > self._free:
-            raise ValueError(
+            raise KVAllocationError(
                 f"cannot allocate {num_pages} pages ({self._free} free)")
         out = np.empty(num_pages, dtype=np.int32)
         for i in range(num_pages):

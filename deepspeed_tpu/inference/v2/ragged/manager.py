@@ -19,10 +19,11 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ....runtime.fault_injection import get_fault_injector
 from ....telemetry import trace_span
 from ....telemetry.flight_recorder import get_flight_recorder
 from ....utils.comms_logging import serving_counters
-from .blocked_allocator import NULL_PAGE
+from .blocked_allocator import KVAllocationError, NULL_PAGE
 from .kv_cache import BlockedKVCache, KVCacheConfig
 from .prefix_cache import PrefixCache
 from .sequence import SequenceDescriptor
@@ -265,6 +266,9 @@ class StateManager:
     def allocate_for(self, sd: SequenceDescriptor, n_new_tokens: int) -> None:
         extra = self.pages_needed(sd, n_new_tokens)
         if extra:
+            get_fault_injector().maybe_raise(
+                "kv.alloc_oom", KVAllocationError,
+                f"injected KV allocator OOM ({extra} pages requested)")
             self.ensure_free(extra)
             sd.extend_pages(self.kv_cache.reserve(extra))
 

@@ -35,6 +35,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ...runtime.fault_injection import (PoisonedRequestFault,
+                                        get_fault_injector)
 from ...telemetry import get_tracer, trace_span
 from ...telemetry import metrics as tm
 from ...telemetry.flight_recorder import get_flight_recorder
@@ -42,6 +44,7 @@ from ...telemetry.state import state as _telemetry
 from ...telemetry.watchdog import get_watchdog
 from ...utils.comms_logging import serving_counters
 from .engine import InferenceEngineV2
+from .ragged.blocked_allocator import KVAllocationError, NULL_PAGE
 from .sampling import SamplingParams, sample
 
 
@@ -65,10 +68,43 @@ class Request:
     first_sched_s: float = 0.0
     last_token_s: float = 0.0
     slo_gen: int = 0
+    #: absolute ``time.monotonic()`` deadline (ISSUE 7); None = no TTL.
+    #: Past it the request drains with a structured "expired" error
+    deadline: Optional[float] = None
+    #: ``time.monotonic()`` at submit — always stamped (unlike the
+    #: telemetry-gated SLO stamps): the shed valve needs the CURRENT
+    #: backlog age even with telemetry off
+    submit_mono: float = 0.0
 
     @property
     def prefill_remaining(self) -> int:
         return len(self.prompt) - self.prompt_sent
+
+
+@dataclasses.dataclass
+class RequestError:
+    """Structured terminal error for a request that did not complete
+    (ISSUE 7 graceful degradation).  ``code`` is one of:
+
+    - ``"shed"``     — rejected by admission control (bounded queue /
+      queue-wait SLO / unservable demand)
+    - ``"expired"``  — deadline/TTL passed before completion
+    - ``"poisoned"`` — an exception attributable to this request was
+      isolated; the step loop kept serving the rest
+    - ``"oom"``      — KV pool exhausted after the degradation ladder
+      (evict parked pages -> preempt -> shed)
+
+    ``tokens`` holds whatever the request generated before
+    termination."""
+    uid: int
+    code: str
+    message: str
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+#: bounded retention for FastGenScheduler.errors — a long-lived
+#: scheduler under sustained shedding must not grow without bound
+_MAX_ERROR_RECORDS = 4096
 
 
 @dataclasses.dataclass
@@ -158,16 +194,123 @@ class FastGenScheduler:
         #: telemetry (ISSUE 4): this scheduler's step ordinal for span
         #: labels (independent of other tracer users in the process)
         self._step_ordinal = 0
+        # -- graceful degradation (ISSUE 7); getattr: a serving=
+        # override may be an older/narrower config object -------------
+        self._max_queue_depth = int(getattr(sv, "max_queue_depth", 0)
+                                    or 0)
+        self._shed_queue_wait_ms = float(
+            getattr(sv, "shed_queue_wait_ms", 0.0) or 0.0)
+        self._default_ttl_s = float(getattr(sv, "default_ttl_s", 0.0)
+                                    or 0.0)
+        self._shed_unservable = bool(getattr(sv, "shed_unservable",
+                                             False))
+        #: structured terminal errors by uid (shed/expired/poisoned/oom)
+        self.errors: Dict[int, RequestError] = {}
+        #: at least one live request carries a deadline (cheap per-step
+        #: guard: deadline-free workloads never scan for expiry)
+        self._has_deadlines = False
+        #: consecutive steps lost to KV-allocation failure (the
+        #: degradation ladder escalates along this streak)
+        self._oom_streak = 0
 
     # -- request lifecycle ---------------------------------------------------
     def submit(self, uid: int, prompt: Sequence[int],
-               params: Optional[SamplingParams] = None) -> None:
+               params: Optional[SamplingParams] = None,
+               ttl_s: Optional[float] = None) -> None:
+        """Queue a request.  ``ttl_s`` (or the config's
+        ``default_ttl_s``) sets a deadline past which the request
+        terminates with a structured "expired" error instead of
+        hanging.  A bounded admission queue (``max_queue_depth``) or a
+        violated queue-wait SLO (``shed_queue_wait_ms``) sheds the
+        request immediately — check :attr:`errors` for the verdict."""
         req = Request(
             uid=uid, prompt=np.asarray(prompt, dtype=np.int32),
             params=params or SamplingParams())
+        now = time.monotonic()
+        req.submit_mono = now
+        ttl = ttl_s if ttl_s is not None else (self._default_ttl_s
+                                               or None)
+        if ttl:
+            req.deadline = now + float(ttl)
+            self._has_deadlines = True
         if _telemetry.enabled:
             req.submit_s = time.perf_counter()
+        if self._max_queue_depth and \
+                len(self._pending) >= self._max_queue_depth:
+            self._fail_request(
+                req, "shed",
+                f"admission queue full ({len(self._pending)} pending "
+                f">= max_queue_depth={self._max_queue_depth})")
+            return
+        if self._shed_queue_wait_ms > 0.0 and self._pending:
+            # SLO-driven load shedding.  The decisive signal is the
+            # CURRENT backlog (oldest pending request already waited
+            # past the SLO — always-on submit_mono stamp, so the valve
+            # works with telemetry off).  The PR 4 queue-wait histogram
+            # confirms when it has data: it is cumulative for the
+            # process life, so it may only VETO (a fresh backlog during
+            # a healthy period is never shed because of a congestion
+            # burst hours ago), never shed on its own.
+            h = tm.FASTGEN_QUEUE_WAIT_MS
+            oldest_ms = (now - self._pending[0].submit_mono) * 1e3
+            if oldest_ms > self._shed_queue_wait_ms and (
+                    h.count < 8
+                    or h.percentile(90.0) > self._shed_queue_wait_ms):
+                self._fail_request(
+                    req, "shed",
+                    f"queue-wait SLO {self._shed_queue_wait_ms:.1f}ms "
+                    f"violated (oldest pending {oldest_ms:.1f}ms, "
+                    f"observed p90 {h.percentile(90.0):.1f}ms over "
+                    f"{h.count} samples)")
+                return
         self._pending.append(req)
+
+    def _fail_request(self, req: Request, code: str,
+                      message: str) -> None:
+        """Terminate ``req`` with a structured error: engine state is
+        flushed, the request leaves every queue, and partial tokens are
+        preserved on the error record.  An in-flight async row for this
+        uid is discarded at drain (``req.done`` gates it — same
+        mechanism as stop-token rollback)."""
+        req.done = True
+        self._pending = [r for r in self._pending if r.uid != req.uid]
+        self._running.pop(req.uid, None)
+        self._preempted.pop(req.uid, None)
+        if self._engine.state_manager.get_sequence(req.uid) is not None:
+            self._engine.flush(req.uid)
+        self.errors[req.uid] = RequestError(
+            uid=req.uid, code=code, message=message,
+            tokens=list(req.generated))
+        while len(self.errors) > _MAX_ERROR_RECORDS:
+            # bounded retention on a long-lived scheduler: drop the
+            # oldest verdicts (dict preserves insertion order)
+            self.errors.pop(next(iter(self.errors)))
+        if code == "shed":
+            tm.FASTGEN_SHED.inc()
+        elif code == "expired":
+            tm.FASTGEN_EXPIRED.inc()
+        else:
+            tm.FASTGEN_REQUEST_ERROR.inc()
+        get_flight_recorder().record(
+            "request.error", uid=req.uid, code=code,
+            message=message[:200], tokens=len(req.generated))
+
+    def _expire_requests(self) -> None:
+        """Terminate every request whose deadline has passed (pending,
+        running, and preempted alike) with a structured error."""
+        if not self._has_deadlines:
+            return
+        now = time.monotonic()
+        expired = [r for r in (list(self._pending)
+                               + list(self._running.values())
+                               + list(self._preempted.values()))
+                   if r.deadline is not None and now >= r.deadline]
+        for req in expired:
+            self._fail_request(
+                req, "expired",
+                f"deadline passed ({len(req.generated)} tokens "
+                f"generated, {req.prefill_remaining} prompt tokens "
+                "unprefilled)")
 
     @property
     def has_work(self) -> bool:
@@ -405,13 +548,22 @@ class FastGenScheduler:
                    ) -> Dict[int, int]:
         serving_counters.record_step()
         self._preempted_this_step = False
+        self._expire_requests()
 
         chain = self._plan_chain()
         if chain is not None:
             # dispatch k+1 FIRST, then drain k: the host sync below
             # overlaps the device executing the new step
-            with trace_span("fastgen.dispatch.chain"):
-                new_inflight = self._dispatch_chain(chain)
+            try:
+                with trace_span("fastgen.dispatch.chain"):
+                    new_inflight = self._dispatch_chain(chain)
+            except KVAllocationError as e:
+                # degraded step: drain what's in flight, run the
+                # ladder, retry through the host path next step
+                out = self._drain(on_token)
+                self._degrade_oom(e, [], [])
+                return out
+            self._oom_streak = 0
             out = self._drain(on_token)
             self._inflight = new_inflight
             return out
@@ -439,12 +591,32 @@ class FastGenScheduler:
             uids: List[int] = []
             tokens: List[np.ndarray] = []
             reqs: List[Request] = []
+            #: (req, chunk) prompt advances this step — rolled back if
+            #: the dispatch below fails, so no prompt token is skipped
+            advances: List[Tuple[Request, int]] = []
+            #: requests moved pending -> running this step — returned
+            #: to pending on a failed dispatch (their engine sequence
+            #: may not exist yet)
+            new_admits: List[Request] = []
+            _faults = get_fault_injector()
 
-            # 1. all running decodes (one token each)
-            for uid, req in self._running.items():
+            # 1. all running decodes (one token each).  Per-request
+            # error isolation (ISSUE 7): an exception attributable to
+            # one request evicts THAT request; the step keeps serving
+            # the rest
+            for uid, req in list(self._running.items()):
                 if req.prefill_remaining > 0:
                     continue  # mid-prefill requests handled below
-                if not adm.try_admit(uid, 1, is_new=False):
+                try:
+                    if _faults.armed and \
+                            _faults.fire("fastgen.poison_request"):
+                        raise PoisonedRequestFault(
+                            f"injected poisoned request {uid}")
+                    if not adm.try_admit(uid, 1, is_new=False):
+                        continue
+                except Exception as e:
+                    self._fail_request(req, "poisoned",
+                                       f"{type(e).__name__}: {e}")
                     continue
                 last = (req.generated[-1] if req.generated
                         else int(req.prompt[-1]))
@@ -457,6 +629,10 @@ class FastGenScheduler:
             def try_prefill(req: Request, is_new: bool) -> bool:
                 if adm.tokens_left <= 0 or req.prefill_remaining == 0:
                     return False
+                if _faults.armed and \
+                        _faults.fire("fastgen.poison_request"):
+                    raise PoisonedRequestFault(
+                        f"injected poisoned request {req.uid}")
                 if is_new and self._prefix_cfg and not req.prefix_checked:
                     with trace_span("fastgen.prefix_match"):
                         self._match_prefix_once(req, adm)
@@ -479,6 +655,7 @@ class FastGenScheduler:
                 tokens.append(piece.astype(np.int32))
                 reqs.append(req)
                 req.prompt_sent += chunk
+                advances.append((req, chunk))
                 serving_counters.record_prefill(chunk)
                 if _telemetry.enabled and req.first_sched_s == 0.0:
                     # first scheduled admission: close the queue-wait
@@ -494,13 +671,24 @@ class FastGenScheduler:
                 return True
 
             for req in list(self._running.values()):
-                try_prefill(req, is_new=False)
+                try:
+                    try_prefill(req, is_new=False)
+                except Exception as e:
+                    self._fail_request(req, "poisoned",
+                                       f"{type(e).__name__}: {e}")
             while self._pending and adm.tokens_left > 0:
                 req = self._pending[0]
-                if not try_prefill(req, is_new=True):
+                try:
+                    admitted = try_prefill(req, is_new=True)
+                except Exception as e:
+                    self._fail_request(req, "poisoned",
+                                       f"{type(e).__name__}: {e}")
+                    continue
+                if not admitted:
                     break
                 self._pending.pop(0)
                 self._running[req.uid] = req
+                new_admits.append(req)
 
         self.last_step_scheduled = len(uids)
         if not uids:
@@ -508,23 +696,7 @@ class FastGenScheduler:
             # sequence holding the most KV so the others can finish —
             # its pages go to host via the offload hook and it resumes
             # automatically once the pool frees up
-            if self._running:
-                # rank by OFFLOADABLE pages: window eviction leaves null
-                # slots and prefix-shared pages (refcount > 1) stay
-                # resident through an offload — neither frees anything,
-                # and a no-op preemption would spin run_to_completion
-                def live_pages(u):
-                    state = self._engine.state_manager
-                    sd = state.get_sequence(u)
-                    return len(state.offloadable_slots(sd)) if sd else 0
-                victim = max(self._running, key=live_pages)
-                if live_pages(victim) > 0:
-                    with trace_span("fastgen.preempt"):
-                        self._engine.offload_sequence(victim)
-                    get_flight_recorder().record("request.preempt",
-                                                 uid=victim)
-                    self._preempted[victim] = self._running.pop(victim)
-                    self._preempted_this_step = True
+            self._preempt_largest()
             return out_prev
 
         sampled_rows = [i for i, r in enumerate(reqs)
@@ -557,10 +729,15 @@ class FastGenScheduler:
             # greedy_only above uses the same sampled-rows-only rule
             row_params = [r.params if r.prefill_remaining == 0
                           else SamplingParams() for r in reqs]
-            with trace_span("fastgen.dispatch.fused"):
-                toks, rowmap = self._engine.step_sample(
-                    uids, tokens, row_params, self._next_key(greedy_only),
-                    do_checks=False)
+            try:
+                with trace_span("fastgen.dispatch.fused"):
+                    toks, rowmap = self._engine.step_sample(
+                        uids, tokens, row_params,
+                        self._next_key(greedy_only), do_checks=False)
+            except KVAllocationError as e:
+                self._degrade_oom(e, advances, new_admits)
+                return out_prev
+            self._oom_streak = 0
             self._inflight = _Inflight(
                 tokens_dev=toks,
                 rows=[(uids[i], rowmap[i], reqs[i])
@@ -579,8 +756,13 @@ class FastGenScheduler:
         if put_fused and strict:
             put_fused = self._strict_key_ok(uids, tokens, ())
         with trace_span("fastgen.dispatch.split"):
-            logits = self._engine.put(uids, tokens, do_checks=False,
-                                      fused=put_fused)
+            try:
+                logits = self._engine.put(uids, tokens, do_checks=False,
+                                          fused=put_fused)
+            except KVAllocationError as e:
+                self._degrade_oom(e, advances, new_admits)
+                return out_prev
+            self._oom_streak = 0
             groups: Dict[tuple, List[int]] = {}
             for i in sampled_rows:
                 groups.setdefault(_group_key(reqs[i].params), []).append(i)
@@ -614,6 +796,92 @@ class FastGenScheduler:
                 del self._running[req.uid]
         return out
 
+    # -- graceful degradation (ISSUE 7) --------------------------------------
+    def _preempt_largest(self) -> bool:
+        """Preempt the running sequence holding the most OFFLOADABLE
+        KV (window eviction leaves null slots and prefix-shared pages
+        stay resident through an offload — neither frees anything, and
+        a no-op preemption would spin run_to_completion)."""
+        if not self._running:
+            return False
+
+        def live_pages(u):
+            state = self._engine.state_manager
+            sd = state.get_sequence(u)
+            return len(state.offloadable_slots(sd)) if sd else 0
+
+        victim = max(self._running, key=live_pages)
+        if live_pages(victim) <= 0:
+            return False
+        with trace_span("fastgen.preempt"):
+            self._engine.offload_sequence(victim)
+        get_flight_recorder().record("request.preempt", uid=victim)
+        self._preempted[victim] = self._running.pop(victim)
+        self._preempted_this_step = True
+        return True
+
+    def _most_demanding_request(self) -> Optional[Request]:
+        """The request whose remaining demand is largest (prefill
+        tokens still owed, then block-table size) — the shed victim
+        that frees the most capacity for everyone else."""
+        cands = (list(self._pending) + list(self._running.values())
+                 + list(self._preempted.values()))
+        if not cands:
+            return None
+
+        def demand(r: Request):
+            sd = self._engine.state_manager.get_sequence(r.uid)
+            pages = (len([p for p in sd.pages if p != NULL_PAGE])
+                     if sd is not None else 0)
+            return (r.prefill_remaining, pages)
+
+        return max(cands, key=demand)
+
+    def _degrade_oom(self, exc: Exception,
+                     advances: List[Tuple[Request, int]],
+                     new_admits: List[Request]) -> None:
+        """KV allocation failed mid-dispatch: degrade instead of
+        crashing the step loop.  The failed step's prompt advances are
+        rolled back (no token is silently skipped), then the ladder
+        escalates along the consecutive-failure streak: (1) reclaim
+        every parked prefix-cache page, (2) preempt the largest
+        sequence, (3) shed the most demanding request with a
+        structured "oom" error."""
+        for req, chunk in advances:
+            req.prompt_sent -= chunk
+        for req in reversed(new_admits):
+            # an admit whose engine sequence never materialized goes
+            # back to the front of the queue (reversed re-insertion at
+            # index 0 preserves FIFO admission order)
+            if self._engine.state_manager.get_sequence(req.uid) is None \
+                    and not req.generated and req.uid in self._running:
+                self._running.pop(req.uid)
+                self._pending.insert(0, req)
+        self._oom_streak += 1
+        tm.KV_ALLOC_FAIL.inc()
+        get_flight_recorder().record(
+            "kv.alloc_fail", streak=self._oom_streak,
+            error=str(exc)[:200])
+        state = self._engine.state_manager
+        alloc = state.kv_cache.allocator
+        if alloc.parked_pages:
+            # rung 1: parked prefix-cache pages are the otherwise-idle
+            # pool — evict them all before touching live requests
+            state.ensure_free(alloc.free_pages + alloc.parked_pages)
+            self._preempted_this_step = True  # pages freed: progress
+        if self._oom_streak >= 2:
+            self._preempt_largest()
+        if self._oom_streak >= 4:
+            victim = self._most_demanding_request()
+            if victim is not None:
+                self._fail_request(
+                    victim, "oom",
+                    "KV pool exhausted after parked-page eviction and "
+                    f"preemption ({self._oom_streak} consecutive "
+                    "allocation failures)")
+                self._preempted_this_step = True
+        self.last_step_scheduled = 0
+
     # -- convenience ---------------------------------------------------------
     def run_to_completion(self) -> Dict[int, List[int]]:
         all_reqs = {r.uid: r for r in self._pending}
@@ -627,13 +895,28 @@ class FastGenScheduler:
                     continue  # preemption IS progress: pages were freed
                 stalls += 1
                 if stalls >= 2:
-                    raise RuntimeError(
+                    if self._shed_unservable:
+                        victim = self._most_demanding_request()
+                        if victim is not None:
+                            self._fail_request(
+                                victim, "oom",
+                                "unservable: nothing schedulable with "
+                                "this request in the pool")
+                            stalls = 0
+                            continue
+                    err = RuntimeError(
                         "scheduler deadlock: work remains but nothing is "
                         "schedulable (KV cache exhausted or a request "
                         "exceeds engine limits); "
                         f"{len(self._pending)} pending, "
                         f"{len(self._running)} running, "
                         f"{self._engine.free_blocks} free KV pages")
+                    # a livelocked serving loop leaves forensics like a
+                    # crashed one: postmortem bundle BEFORE raising
+                    # (once per process, never masks the error)
+                    get_flight_recorder().on_crash(
+                        "fastgen.run_to_completion", err)
+                    raise err
             else:
                 stalls = 0
         return {uid: req.generated for uid, req in all_reqs.items()}

@@ -267,6 +267,49 @@ class CheckpointConfig(DeepSpeedConfigModel):
     use_node_local_storage: bool = False
     parallel_write: Dict[str, Any] = Field(default_factory=dict)
     async_save: bool = True  # orbax async checkpointing
+    #: transient-I/O (OSError) retries per checkpoint operation, with
+    #: exponential backoff starting at save_backoff_s (ISSUE 7)
+    save_retries: int = 3
+    save_backoff_s: float = 0.05
+
+
+class FaultInjectionConfig(DeepSpeedConfigModel):
+    """``fault_injection`` section — the deterministic chaos registry
+    (``runtime/fault_injection.py``).  ``sites`` maps injection-site
+    names to specs (``{"probability": .., "at_calls": [..],
+    "max_fires": .., "value": ..}``); unknown site names raise at
+    apply time.  ``enabled: false`` (default) leaves the process
+    registry alone — in particular it does NOT disarm a ``DS_CHAOS``
+    env arming, so one engine's default config can't silence a chaos
+    run."""
+    enabled: bool = False
+    seed: int = 0
+    sites: Dict[str, Dict[str, Any]] = Field(default_factory=dict)
+
+    def apply(self) -> None:
+        from .fault_injection import apply_fault_injection
+        apply_fault_injection(self.enabled, self.seed, self.sites)
+
+
+class FaultToleranceConfig(DeepSpeedConfigModel):
+    """``fault_tolerance`` section — training self-healing (ISSUE 7).
+
+    With ``self_healing`` on, ``train_batch`` turns watchdog verdicts
+    into recovery actions: a non-finite loss/grad-norm on an APPLIED
+    step (fp16 overflow skips stay routine) rolls the engine back to
+    the last good checkpoint — or to an in-memory host snapshot when no
+    checkpoint exists yet — and skips the offending batch window;
+    transient faults (:class:`~.fault_injection.TransientFault`) raised
+    at dispatch are retried with the same budget.  ``max_retries``
+    bounds CONSECUTIVE rollbacks/retries (the budget resets on every
+    healthy step); each consecutive recovery sleeps
+    ``backoff_s * 2**(n-1)``.  ``snapshot_interval > 0`` refreshes the
+    in-memory rollback snapshot every N applied steps (0 = snapshot
+    only once, lazily, at the first self-healed batch)."""
+    self_healing: bool = False
+    max_retries: int = 3
+    backoff_s: float = 0.05
+    snapshot_interval: int = 0
 
 
 class ElasticityConfig(DeepSpeedConfigModel):
@@ -387,6 +430,20 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
     on_device_sampling: bool = True
     async_scheduling: bool = True
     prefix_caching: bool = True
+    # -- graceful degradation (ISSUE 7); 0 = off, preserving the
+    # unbounded seed behavior ------------------------------------------
+    #: bounded admission queue: a submit past this many pending
+    #: requests is SHED with a structured error (0 = unbounded)
+    max_queue_depth: int = 0
+    #: SLO-driven load shedding: with telemetry on, shed new submits
+    #: while the observed queue-wait p90 exceeds this (0 = off)
+    shed_queue_wait_ms: float = 0.0
+    #: default per-request TTL in seconds; expired requests drain with
+    #: a structured error instead of hanging (0 = no deadline)
+    default_ttl_s: float = 0.0
+    #: on a would-be scheduler deadlock, shed the most demanding
+    #: request with a structured "oom" error instead of raising
+    shed_unservable: bool = False
 
     def to_v2_dict(self) -> Dict[str, Any]:
         """The ``serving_optimization`` dict the inference-v2 config
@@ -394,7 +451,11 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
         return {"enabled": self.enabled, "fused_step": self.fused_step,
                 "on_device_sampling": self.on_device_sampling,
                 "async_scheduling": self.async_scheduling,
-                "prefix_caching": self.prefix_caching}
+                "prefix_caching": self.prefix_caching,
+                "max_queue_depth": self.max_queue_depth,
+                "shed_queue_wait_ms": self.shed_queue_wait_ms,
+                "default_ttl_s": self.default_ttl_s,
+                "shed_unservable": self.shed_unservable}
 
 
 class TPUConfig(DeepSpeedConfigModel):
@@ -453,6 +514,10 @@ class DeepSpeedTPUConfig(DeepSpeedConfigModel):
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    fault_injection: FaultInjectionConfig = Field(
+        default_factory=FaultInjectionConfig)
+    fault_tolerance: FaultToleranceConfig = Field(
+        default_factory=FaultToleranceConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)

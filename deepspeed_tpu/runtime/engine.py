@@ -59,6 +59,8 @@ from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, TRAIN_BATCH_TIMER,
                            SynchronizedWallClockTimer, ThroughputTimer)
 from .config import DeepSpeedTPUConfig, load_config
+from .fault_injection import (InjectedCollectiveFault, TransientFault,
+                              get_fault_injector)
 from .lr_schedules import LRScheduler, get_lr_schedule
 from .optimizers import get_optimizer
 from .zero.partitioner import ZeroPartitioner, unbox
@@ -238,6 +240,14 @@ class DeepSpeedEngine:
 
         # -- io/observability ---------------------------------------------
         self.config.telemetry.apply()
+        self.config.fault_injection.apply()
+        # self-healing state (ISSUE 7): the last checkpoint this engine
+        # wrote, an in-memory host snapshot when no checkpoint exists
+        # yet, and the consecutive-recovery counter the retry budget
+        # bounds
+        self._last_good_ckpt: Optional[Tuple[str, str]] = None
+        self._state_snapshot: Optional[dict] = None
+        self._rollback_streak = 0
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
@@ -592,7 +602,10 @@ class DeepSpeedEngine:
 
     def _build_checkpoint_engine(self):
         from ..checkpoint.engine import OrbaxCheckpointEngine
-        return OrbaxCheckpointEngine(async_save=self.config.checkpoint.async_save)
+        ckpt = self.config.checkpoint
+        return OrbaxCheckpointEngine(async_save=ckpt.async_save,
+                                     save_retries=ckpt.save_retries,
+                                     save_backoff_s=ckpt.save_backoff_s)
 
     # ------------------------------------------------------------------
     # the fused train step
@@ -932,26 +945,187 @@ class DeepSpeedEngine:
 
     def train_batch(self, batch=None, data_iter: Optional[Iterable] = None) -> float:
         """Run one full training step: gas micro-batches + optimizer update
-        (reference PipelineEngine.train_batch / engine fwd+bwd+step cycle)."""
-        try:
-            return self._train_batch_impl(batch, data_iter)
-        except Exception as e:
-            # crash forensics (ISSUE 5): leave a postmortem bundle
-            # before the exception leaves the engine; never masks it
-            get_flight_recorder().on_crash("train_batch", e)
-            raise
+        (reference PipelineEngine.train_batch / engine fwd+bwd+step cycle).
 
-    def _train_batch_impl(self, batch, data_iter) -> float:
+        With ``fault_tolerance.self_healing`` on, watchdog verdicts
+        become recovery actions: a non-finite applied step rolls back to
+        the last good checkpoint/snapshot and skips the batch window;
+        transient dispatch faults are retried — both bounded by
+        ``max_retries`` consecutive recoveries with exponential
+        backoff."""
+        ft = self.config.fault_tolerance
+        if not ft.self_healing:
+            try:
+                return self._train_batch_impl(batch, data_iter)
+            except Exception as e:
+                # crash forensics (ISSUE 5): leave a postmortem bundle
+                # before the exception leaves the engine; never masks it
+                get_flight_recorder().on_crash("train_batch", e)
+                raise
+        return self._train_batch_self_healing(batch, data_iter, ft)
+
+    # -- self-healing wrapper (ISSUE 7) ---------------------------------
+    def _train_batch_self_healing(self, batch, data_iter, ft) -> float:
         self._check_not_destroyed()
+        if self._last_good_ckpt is None and self._state_snapshot is None:
+            # a rollback target must exist BEFORE the first guarded step
+            self._snapshot_state()
+        # materialize the batch once: a transient-fault retry must replay
+        # the SAME data, not consume fresh micro-batches from the iterator
+        batch = self._resolve_batch(batch, data_iter)
+        attempt = 0
+        while True:
+            try:
+                loss = self._train_batch_impl(batch, None)
+            except TransientFault as e:
+                # dispatch-boundary failure: no state was mutated, so
+                # the same batch is retried after backoff
+                attempt += 1
+                tm.TRAIN_RETRY.inc()
+                get_flight_recorder().record(
+                    "selfheal.retry", attempt=attempt,
+                    error=f"{type(e).__name__}: {e}"[:200])
+                if attempt > ft.max_retries:
+                    get_flight_recorder().on_crash("train_batch", e)
+                    raise
+                logger.warning(
+                    "self-healing: transient fault in train_batch (%s) "
+                    "— retry %d/%d", e, attempt, ft.max_retries)
+                time.sleep(ft.backoff_s * (2 ** (attempt - 1)))
+                continue
+            except Exception as e:
+                get_flight_recorder().on_crash("train_batch", e)
+                raise
+            applied = getattr(self, "_last_step_applied", True)
+            bad = applied and not (
+                math.isfinite(loss)
+                and math.isfinite(getattr(self, "_last_grad_norm", 0.0)))
+            if not bad:
+                self._rollback_streak = 0
+                self._maybe_refresh_snapshot(ft)
+                return loss
+            # non-finite verdict on an APPLIED step: params may hold
+            # NaN/inf — roll back and skip the offending batch window
+            self._rollback_streak += 1
+            tm.TRAIN_ROLLBACK.inc()
+            bad_step = self.global_steps
+            get_flight_recorder().record(
+                "selfheal.rollback", streak=self._rollback_streak,
+                at_step=bad_step, loss=repr(loss))
+            time.sleep(ft.backoff_s * (2 ** (self._rollback_streak - 1)))
+            # restore FIRST even when about to give up: the caller
+            # catches the exception with the engine at last-good state,
+            # not with NaN params
+            source = self._restore_last_good()
+            if self._rollback_streak > ft.max_retries:
+                err = RuntimeError(
+                    f"self-healing: {self._rollback_streak} consecutive "
+                    f"non-finite steps exceed "
+                    f"fault_tolerance.max_retries={ft.max_retries}")
+                get_flight_recorder().on_crash("train_batch", err)
+                raise err
+            logger.warning(
+                "self-healing: non-finite step at global step %d — "
+                "rolled back to %s and skipped the batch window "
+                "(rollback %d/%d)", bad_step, source,
+                self._rollback_streak, ft.max_retries)
+            return loss  # the non-finite loss is surfaced, not hidden
+
+    def _snapshot_state(self) -> None:
+        """Host copy of everything a rollback must restore (device state,
+        RNG stream, host-side step counters, LR-scheduler state)."""
+        self._state_snapshot = {
+            "state": jax.device_get(self.state),
+            "rng": np.asarray(jax.random.key_data(self._rng)),
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "lr_scheduler": self.lr_scheduler.state_dict(),
+        }
+
+    def _maybe_refresh_snapshot(self, ft) -> None:
+        if ft.snapshot_interval > 0 and \
+                self.global_steps % ft.snapshot_interval == 0:
+            self._snapshot_state()
+
+    def _restore_last_good(self) -> str:
+        """Roll device + host state back to the last good checkpoint
+        (preferred: it survives the process too) or the in-memory
+        snapshot.  Returns a description of the source used."""
+        if self._last_good_ckpt is not None:
+            save_dir, tag = self._last_good_ckpt
+            try:
+                self._load_checkpoint_impl(save_dir, tag, True, True,
+                                           False)
+                return f"checkpoint {tag}"
+            except Exception as e:
+                if self._state_snapshot is None:
+                    raise
+                logger.warning(
+                    "self-healing: checkpoint rollback to %s failed "
+                    "(%s) — falling back to the in-memory snapshot",
+                    tag, e)
+        snap = self._state_snapshot
+        if snap is None:
+            raise RuntimeError("self-healing: no rollback target")
+        with self.topology.mesh:
+            self.state = jax.device_put(snap["state"],
+                                        self._state_shardings_cache)
+        self._rng = jax.random.wrap_key_data(jnp.asarray(snap["rng"]))
+        self.global_steps = snap["global_steps"]
+        self.global_samples = snap["global_samples"]
+        self.micro_steps = snap["micro_steps"]
+        self.lr_scheduler.load_state_dict(snap["lr_scheduler"])
+        return f"snapshot at step {snap['global_steps']}"
+
+    def _resolve_batch(self, batch, data_iter):
+        """Materialize one [gas, micro, ...] host batch from whichever
+        source the caller provided (idempotent on an already-shaped
+        batch)."""
         if batch is None:
             source = data_iter if data_iter is not None else self.training_dataloader
             if source is None:
                 raise ValueError("no batch and no dataloader")
             it = source if hasattr(source, "__next__") else iter(source)
             micro = [next(it) for _ in range(self.gradient_accumulation_steps())]
-            batch = jax.tree.map(lambda *xs: np.stack(xs), *micro)
-        else:
-            batch = self._shape_batch(batch)
+            return jax.tree.map(lambda *xs: np.stack(xs), *micro)
+        return self._shape_batch(batch)
+
+    def _train_batch_impl(self, batch, data_iter) -> float:
+        self._check_not_destroyed()
+        batch = self._resolve_batch(batch, data_iter)
+
+        # fault-injection sites (ISSUE 7), all BEFORE any timer/state
+        # mutation so an injected failure aborts cleanly:
+        # a collective failure raises retry-safe (nothing dispatched);
+        # a NaN batch flows through the REAL fused step so recovery must
+        # genuinely repair state
+        fi = get_fault_injector()
+        if fi.armed:
+            fi.maybe_raise("comm.collective_failure",
+                           InjectedCollectiveFault,
+                           "injected collective failure at dispatch")
+            if fi.has_site("train.nan_grad"):
+                # only probe the site when the batch actually has a
+                # float leaf to poison — an int-only (token-id) batch
+                # must not count a fault as injected while injecting
+                # nothing
+                poisonable = any(
+                    np.issubdtype(np.asarray(x).dtype, np.floating)
+                    for x in jax.tree.leaves(batch))
+                if not poisonable:
+                    if not getattr(self, "_nan_site_warned", False):
+                        self._nan_site_warned = True
+                        logger.warning(
+                            "fault injection: train.nan_grad is armed "
+                            "but the batch has no floating-point leaf "
+                            "to poison — site skipped (not counted)")
+                elif fi.fire("train.nan_grad"):
+                    batch = jax.tree.map(
+                        lambda x: np.full_like(x, np.nan)
+                        if np.issubdtype(np.asarray(x).dtype,
+                                         np.floating)
+                        else x, batch)
 
         if not getattr(self, "_train_mode", True) and \
                 not getattr(self, "_eval_mode_warned", False):
@@ -1001,6 +1175,10 @@ class DeepSpeedEngine:
         self._last_grad_norm = float(metrics["grad_norm"])
         self._last_step_applied = not (self._fp16_enabled
                                        and bool(metrics["overflow"]))
+        if fi.armed and fi.fire("train.slow_step"):
+            # inside the measured window, so the EWMA anomaly detector
+            # sees the stall exactly like a real straggler step
+            time.sleep(fi.site_value("train.slow_step", 100.0) / 1e3)
         if telemetry_state.enabled:
             # non-finite sentinel (ISSUE 5): loss and grad_norm are the
             # HOST-fetched floats above — no new device syncs.  A
@@ -1207,6 +1385,11 @@ class DeepSpeedEngine:
             "global_samples": self.global_samples,
             "micro_steps": self.micro_steps,
             "lr_scheduler": self.lr_scheduler.state_dict(),
+            # the engine RNG stream: a resume (or self-healing
+            # rollback) replays the same randomness whichever rollback
+            # source is used — checkpoint and snapshot must not diverge
+            "rng_key_data": np.asarray(
+                jax.random.key_data(self._rng)).tolist(),
             # topology fingerprint for universal-checkpoint reshaping:
             # pipeline params are stage-stacked [S, L/S, ...] on disk and
             # ds_to_universal must unstack them into topology-free atoms
@@ -1218,7 +1401,18 @@ class DeepSpeedEngine:
             self.offload.save_npz(os.path.join(
                 save_dir, tag, f"offload_rank{jax.process_index()}.npz"))
         if save_latest:
+            # write_latest LAST (atomic tmp+rename), and only after any
+            # async serialization has fully drained — otherwise a crash
+            # between dispatch and finalization leaves `latest` naming
+            # an incomplete checkpoint.  The pointer update trades the
+            # tail of the async overlap for durability; callers that
+            # want the full overlap pass save_latest=False and commit
+            # the pointer at their own barrier.
+            self.checkpoint_engine.wait()
             self.checkpoint_engine.write_latest(save_dir, tag)
+        # a completed save is the freshest rollback target for the
+        # self-healing path (the async drain is awaited at load time)
+        self._last_good_ckpt = (save_dir, tag)
         return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -1326,6 +1520,9 @@ class DeepSpeedEngine:
         self.global_steps = client_state.get("global_steps", 0)
         self.global_samples = client_state.get("global_samples", 0)
         self.micro_steps = client_state.get("micro_steps", 0)
+        if "rng_key_data" in client_state:
+            self._rng = jax.random.wrap_key_data(jnp.asarray(np.array(
+                client_state["rng_key_data"], dtype=np.uint32)))
         if load_lr_scheduler_states and "lr_scheduler" in client_state:
             self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
         return tag, client_state

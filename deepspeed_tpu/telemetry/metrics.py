@@ -6,7 +6,7 @@ one place to drift from — and ``tools/check_metrics.py`` lints this
 registry against docs/DESIGN.md's metric table in tier-1.
 
 Naming convention: ``ds_<area>_<name>`` with area one of
-{serving, comm, kv, train, fastgen}; counters end in ``_total``.
+{serving, comm, kv, train, fastgen, chaos}; counters end in ``_total``.
 """
 
 from __future__ import annotations
@@ -113,6 +113,34 @@ FASTGEN_STEP_CACHE_MISS = registry.counter(
 FASTGEN_COMPILE_ON_PATH = registry.counter(
     "ds_fastgen_compile_on_path_total",
     "XLA compiles executed on the serving request path")
+
+# -- fault injection + self-healing (ISSUE 7) --------------------------------
+CHAOS_INJECTED = registry.counter(
+    "ds_chaos_injected_total",
+    "faults fired by the fault-injection registry")
+TRAIN_ROLLBACK = registry.counter(
+    "ds_train_rollback_total",
+    "self-healing rollbacks to the last good checkpoint/snapshot after "
+    "a non-finite applied step")
+TRAIN_RETRY = registry.counter(
+    "ds_train_retry_total",
+    "train_batch attempts retried after a transient (retry-safe) fault")
+TRAIN_CKPT_RETRY = registry.counter(
+    "ds_train_ckpt_retry_total",
+    "checkpoint I/O operations retried after an OSError")
+FASTGEN_SHED = registry.counter(
+    "ds_fastgen_shed_total",
+    "requests shed by admission control (queue depth / queue-wait SLO / "
+    "unservable demand)")
+FASTGEN_EXPIRED = registry.counter(
+    "ds_fastgen_expired_total",
+    "requests terminated because their deadline/TTL passed")
+FASTGEN_REQUEST_ERROR = registry.counter(
+    "ds_fastgen_request_error_total",
+    "requests evicted by per-request error isolation (poisoned/oom)")
+KV_ALLOC_FAIL = registry.counter(
+    "ds_kv_alloc_fail_total",
+    "KV-page allocation failures absorbed by the degradation ladder")
 
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(

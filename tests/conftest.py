@@ -52,9 +52,12 @@ def pytest_collection_modifyitems(config, items):
     """Apply the central heavy-marker table (reference
     tests/unit/ci_promote_marker.py pattern: per-tier markers maintained
     centrally, test bodies untouched)."""
-    from heavy_marker import HEAVY_TESTS, SLOW_TESTS
+    from heavy_marker import CHAOS_TESTS, HEAVY_TESTS, SLOW_TESTS
     for item in items:
         if item.nodeid in HEAVY_TESTS:
             item.add_marker(pytest.mark.heavy)
         if item.nodeid in SLOW_TESTS:
             item.add_marker(pytest.mark.slow)
+        if item.nodeid in CHAOS_TESTS or \
+                item.nodeid.startswith("tests/test_chaos.py::"):
+            item.add_marker(pytest.mark.chaos)

@@ -22,7 +22,7 @@ from typing import List
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-AREAS = ("serving", "comm", "kv", "train", "fastgen")
+AREAS = ("serving", "comm", "kv", "train", "fastgen", "chaos")
 NAME_RE = re.compile(
     r"^ds_(%s)_[a-z][a-z0-9_]*$" % "|".join(AREAS))
 
