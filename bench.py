@@ -430,6 +430,86 @@ def bench_fastgen(jax):
                     errs / n_req, 3)
                 result["fastgen_chaos_injected_total"] = \
                     tmet.CHAOS_INJECTED.value - inj0
+                # preemption-tolerance sub-leg (ISSUE 8): snapshot a
+                # live scheduler mid-workload, restore into a fresh
+                # scheduler, and measure how much of the warm prefix
+                # cache survives the restart.  A dedicated small-page
+                # engine (the prefix leg's pattern: the CPU-debug
+                # model's 64-token context can't hold full pages +
+                # suffix on 64-token pages).
+                import tempfile
+                from deepspeed_tpu.inference.v2 import KVCacheConfig
+                page = 16
+                smodel = LlamaForCausalLM(model_size, max_seq_len=256)
+                scfg = smodel.cfg
+                s_kv = KVCacheConfig(
+                    num_layers=scfg.num_layers, kv_heads=scfg.kv_heads,
+                    head_dim=scfg.dims_per_head, page_size=page,
+                    num_pages=256)
+                s_params = meta.unbox(
+                    smodel.init_params(jax.random.key(0)))
+                s_rmodel = RaggedInferenceModel(scfg, s_params,
+                                                kv_config=s_kv)
+                seng = InferenceEngineV2(s_rmodel)
+                prefix = rng.integers(0, scfg.vocab_size, size=4 * page)
+                sp_s = SamplingParams(max_new_tokens=16, temperature=0.0)
+
+                def s_prompts(n, seed):
+                    r = np.random.default_rng(seed)
+                    return [np.concatenate(
+                        [prefix, r.integers(0, scfg.vocab_size, size=12)]
+                    ).tolist() for _ in range(n)]
+
+                def s_sched():
+                    sched = FastGenScheduler(seng)
+                    return sched
+
+                # warm shapes + the prefix cache, like production
+                sched = s_sched()
+                for i, p in enumerate(s_prompts(8, 1)):
+                    sched.submit(i, p, sp_s)
+                sched.run_to_completion()
+                # interrupt a fresh wave mid-flight
+                sched = s_sched()
+                for i, p in enumerate(s_prompts(8, 2)):
+                    sched.submit(i, p, sp_s)
+                for _ in range(4):
+                    sched.step()
+                snap_path = os.path.join(tempfile.gettempdir(),
+                                         f"ds_snap_{os.getpid()}.bin")
+                t0 = time.perf_counter()
+                sched.snapshot(snap_path)
+                result["fastgen_snapshot_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 2)
+                result["fastgen_snapshot_bytes"] = \
+                    os.path.getsize(snap_path)
+                # a "fresh replica": same pool, emptied
+                for uid in list(seng.state_manager._seqs):
+                    seng.flush(uid)
+                seng.reset_prefix_cache()
+                sched2 = FastGenScheduler(seng)
+                t0 = time.perf_counter()
+                sched2.restore(snap_path)
+                result["fastgen_restore_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 2)
+                sched2.run_to_completion()
+                # post-restore warm TTFT: new requests sharing the
+                # prefix hit the RESTORED cache
+                first_t = {}
+                post = FastGenScheduler(seng)
+                t0 = time.perf_counter()
+                for i, p in enumerate(s_prompts(8, 3)):
+                    post.submit(100 + i, p, sp_s)
+                while post.has_work:
+                    out = post.step()
+                    now = time.perf_counter()
+                    for uid in out:
+                        first_t.setdefault(uid, now)
+                ttfts = sorted(t - t0 for t in first_t.values())
+                if ttfts:
+                    result["fastgen_restore_warm_ttft_p50_ms"] = round(
+                        1e3 * ttfts[len(ttfts) // 2], 1)
+                os.unlink(snap_path)
             except Exception as e:  # noqa: BLE001
                 get_fault_injector().disarm()
                 sys.stderr.write(f"bench: fastgen chaos leg failed: "

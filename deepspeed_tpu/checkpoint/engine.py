@@ -35,16 +35,50 @@ from ..utils.logging import logger
 LATEST_FILE = "latest"
 
 
-def _atomic_write_text(path: str, text: str) -> None:
-    """Write ``text`` to ``path`` atomically: tmp file in the same
-    directory, fsync, rename.  A reader never observes a torn write; a
-    crash leaves at worst a stale ``<path>.tmp.<pid>``."""
+def _atomic_write_bytes(path: str, data) -> None:
+    """Write ``data`` — one buffer or a sequence of buffers, streamed
+    without concatenation (snapshot bundles can be KV-pool-sized) — to
+    ``path`` atomically: tmp file in the same directory, fsync, rename.
+    A reader never observes a torn write; a crash leaves at worst a
+    stale ``<path>.tmp.<pid>`` next to the previous (still-valid)
+    file.  Shared by the ``latest`` pointer, ``client_state.json``,
+    and the serving snapshot bundles (ISSUE 8)."""
+    segments = ((data,) if isinstance(data, (bytes, bytearray,
+                                             memoryview)) else data)
     tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        f.write(text)
+    with open(tmp, "wb") as f:
+        for seg in segments:
+            f.write(seg)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    _atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def with_retries(what: str, fn: Callable[[], Any], retries: int = 3,
+                 backoff_s: float = 0.05) -> Any:
+    """Run ``fn``, retrying ``OSError`` up to ``retries`` times with
+    exponential backoff (counted in ``ds_train_ckpt_retry_total``).
+    Non-I/O failures propagate immediately (they are bugs, not
+    weather).  The checkpoint engines and the serving snapshot writer
+    share this one implementation."""
+    delay = backoff_s
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt >= retries:
+                raise
+            tm.TRAIN_CKPT_RETRY.inc()
+            logger.warning(
+                "checkpoint %s failed (%s: %s) — retry %d/%d in "
+                "%.2fs", what, type(e).__name__, e, attempt + 1,
+                retries, delay)
+            time.sleep(delay)
+            delay *= 2
 
 
 class CheckpointEngine(abc.ABC):
@@ -67,23 +101,8 @@ class CheckpointEngine(abc.ABC):
         publishing a pointer (``latest``) to the saved tag."""
 
     def _with_retries(self, what: str, fn: Callable[[], Any]) -> Any:
-        """Run ``fn``, retrying ``OSError`` up to ``save_retries`` times
-        with exponential backoff.  Non-I/O failures propagate
-        immediately (they are bugs, not weather)."""
-        delay = self.save_backoff_s
-        for attempt in range(self.save_retries + 1):
-            try:
-                return fn()
-            except OSError as e:
-                if attempt >= self.save_retries:
-                    raise
-                tm.TRAIN_CKPT_RETRY.inc()
-                logger.warning(
-                    "checkpoint %s failed (%s: %s) — retry %d/%d in "
-                    "%.2fs", what, type(e).__name__, e, attempt + 1,
-                    self.save_retries, delay)
-                time.sleep(delay)
-                delay *= 2
+        return with_retries(what, fn, self.save_retries,
+                            self.save_backoff_s)
 
     def write_latest(self, save_dir: str, tag: str) -> None:
         if jax.process_index() == 0:

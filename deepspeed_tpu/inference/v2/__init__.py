@@ -11,6 +11,9 @@ from .ragged import (BlockedAllocator, BlockedKVCache, KVCacheConfig,
 from .ragged.blocked_allocator import KVAllocationError
 from .sampling import SamplingParams, sample, sample_dynamic
 from .scheduler import FastGenScheduler, Request, RequestError, generate
+from .snapshot import (SNAPSHOT_VERSION, SnapshotError,
+                       install_drain_handler, maybe_install_drain_handler,
+                       read_bundle, write_bundle)
 
 __all__ = [
     "KVCacheUserConfig", "RaggedInferenceEngineConfig",
@@ -23,4 +26,6 @@ __all__ = [
     "SamplingParams", "sample", "sample_dynamic",
     "FastGenScheduler", "Request", "RequestError", "generate",
     "FaultInjectionConfig", "KVAllocationError",
+    "SNAPSHOT_VERSION", "SnapshotError", "install_drain_handler",
+    "maybe_install_drain_handler", "read_bundle", "write_bundle",
 ]

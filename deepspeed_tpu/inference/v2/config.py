@@ -70,6 +70,13 @@ class ServingOptimizationConfig:
     #: on a would-be scheduler deadlock, shed the most demanding
     #: request with a structured "oom" error instead of raising
     shed_unservable: bool = False
+    #: preemption tolerance (ISSUE 8): grace budget in seconds for the
+    #: SIGTERM drain->snapshot path; past it live requests terminate
+    #: with a structured "migrated" error instead of vanishing
+    snapshot_grace_s: float = 5.0
+    #: bundle path the SIGTERM handler writes (with
+    #: DS_DRAIN_ON_SIGTERM=1); empty = snapshot() explicit calls only
+    snapshot_path: str = ""
 
 
 @dataclasses.dataclass

@@ -89,14 +89,22 @@ class BlockedKVCache:
             self.allocator.free(pages)
 
     # -- sequence offload/restore (reference kv_cache.py:166-184) --------
+    def read_pages(self, pages) -> "np.ndarray":
+        """Copy the given pages to host WITHOUT freeing them — the
+        page-transfer export half shared by serving snapshots (ISSUE 8)
+        and, by design, the future replica-to-replica migration path
+        (ROADMAP item 4).  Returns the host blob [L, n, page, 2, K, D];
+        ``restore_pages`` is the matching import."""
+        import numpy as np
+        idx = jnp.asarray(list(pages), jnp.int32)
+        return np.asarray(self.data[:, idx])
+
     def offload_pages(self, pages) -> "np.ndarray":
         """Copy the given pages to HOST memory and free them on device —
         the preemption half of the reference's offload/restore hooks
         (evict a long sequence's KV under pressure, bring it back
         later).  Returns the host blob [L, n, page, 2, K, D]."""
-        import numpy as np
-        idx = jnp.asarray(list(pages), jnp.int32)
-        blob = np.asarray(self.data[:, idx])
+        blob = self.read_pages(pages)
         self.release(list(pages))
         return blob
 

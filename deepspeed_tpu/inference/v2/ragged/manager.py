@@ -40,6 +40,12 @@ class StateManager:
         self.prefix_cache: Optional[PrefixCache] = (
             PrefixCache(kv_config.page_size) if prefix_caching else None)
         self._seqs: Dict[int, SequenceDescriptor] = {}
+        # offloaded-host-blob accounting (ISSUE 8): preempted sequences
+        # hold KV in host blobs that device-page accounting can't see —
+        # tracked here so expiry/flush of a preempted request provably
+        # releases its blob (check_invariants audits the counters)
+        self._offload_blobs = 0
+        self._offload_bytes = 0
 
     # -- sequence tracking --------------------------------------------------
     @property
@@ -54,6 +60,16 @@ class StateManager:
         if self.prefix_cache is not None:
             free += self.kv_cache.allocator.parked_pages
         return free
+
+    @property
+    def offloaded_blobs(self) -> int:
+        """Sequences currently holding host-offloaded KV blobs."""
+        return self._offload_blobs
+
+    @property
+    def offloaded_blob_bytes(self) -> int:
+        """Host bytes held by offloaded (preempted) sequences' blobs."""
+        return self._offload_bytes
 
     def get_sequence(self, uid: int) -> Optional[SequenceDescriptor]:
         return self._seqs.get(uid)
@@ -180,6 +196,13 @@ class StateManager:
         return [i for i, p in enumerate(sd.pages)
                 if p != NULL_PAGE and alloc.ref_count(p) == 1]
 
+    def _release_blob(self, sd: SequenceDescriptor) -> None:
+        """Drop a sequence's offloaded host blob and its accounting."""
+        self._offload_blobs -= 1
+        self._offload_bytes -= sd.host_blob.nbytes
+        sd.host_blob = None
+        sd.live_slots = []
+
     def flush_sequence(self, uid: int) -> None:
         sd = self._seqs.pop(uid, None)
         if sd is not None:
@@ -188,6 +211,12 @@ class StateManager:
                 # ours
                 self._release_pages(
                     [p for p in sd.pages if p != NULL_PAGE])
+                if sd.host_blob is not None:
+                    # a request expired/cancelled WHILE PREEMPTED must
+                    # release its offloaded host blob too, not just its
+                    # device pages (the blob accounting audit would
+                    # otherwise report the leak forever)
+                    self._release_blob(sd)
 
     def offload_sequence(self, uid: int) -> None:
         """Preempt: move a sequence's PRIVATE live KV pages to host
@@ -220,6 +249,8 @@ class StateManager:
                 # with unmatchable entries that flush would then park
                 sd.prompt_tokens = None
         sd.host_blob = self.kv_cache.offload_pages(live)
+        self._offload_blobs += 1
+        self._offload_bytes += sd.host_blob.nbytes
         for i in sd.live_slots:
             sd.pages[i] = NULL_PAGE
 
@@ -234,8 +265,7 @@ class StateManager:
             pages = self.kv_cache.restore_pages(sd.host_blob)
             for slot, p in zip(sd.live_slots, pages):
                 sd.pages[slot] = int(p)
-            sd.host_blob = None
-            sd.live_slots = []
+            self._release_blob(sd)
         # restored pages are private again; if offload unindexed any of
         # them it also disabled this sequence's indexing (broken chain),
         # otherwise the digest chain is intact and indexing continues
@@ -254,6 +284,152 @@ class StateManager:
         if freed:
             self._release_pages(freed)
         return len(freed)
+
+    # -- snapshot export/import (ISSUE 8) -----------------------------------
+    # The export/import pair is deliberately the page-transfer seam
+    # ROADMAP item 4's prefill/decode disaggregation and multi-replica
+    # migration will ride: everything crosses as (JSON-able meta, named
+    # numpy arrays), with page ids remapped on import so the receiving
+    # pool's layout is free to differ.
+
+    def export_state(self) -> tuple:
+        """Serialize every tracked sequence, the prefix-cache index, and
+        the referenced KV page CONTENTS (each distinct device page
+        written once — sharing and refcounts are reconstructed from the
+        block tables on import).  Requires drained state (no in-flight
+        tokens).  Returns ``(meta, arrays)``."""
+        from ..snapshot import SnapshotError
+        page_order: List[int] = []
+        seen = set()
+        for sd in self._seqs.values():
+            if sd.in_flight_tokens:
+                raise SnapshotError(
+                    f"sequence {sd.uid} has {sd.in_flight_tokens} "
+                    "in-flight tokens — drain the step before export")
+            for p in sd.pages:
+                if p != NULL_PAGE and p not in seen:
+                    seen.add(p)
+                    page_order.append(int(p))
+        prefix_entries = []
+        if self.prefix_cache is not None:
+            prefix_entries = self.prefix_cache.export_entries()
+            for _, p in prefix_entries:
+                if p not in seen:       # parked (cache-retained) page
+                    seen.add(p)
+                    page_order.append(int(p))
+        arrays: Dict[str, np.ndarray] = {}
+        if page_order:
+            arrays["page_blob"] = self.kv_cache.read_pages(page_order)
+        seqs = []
+        for uid, sd in self._seqs.items():
+            m = {"uid": int(uid), "seen_tokens": int(sd.seen_tokens),
+                 "pages": [int(p) for p in sd.pages],
+                 "live_slots": [int(i) for i in sd.live_slots],
+                 "indexed_pages": int(sd.indexed_pages),
+                 "last_digest": sd.last_digest.hex(),
+                 "has_prompt": sd.prompt_tokens is not None,
+                 "has_blob": sd.host_blob is not None}
+            if sd.prompt_tokens is not None:
+                arrays[f"prompt_{uid}"] = np.asarray(sd.prompt_tokens,
+                                                     np.int32)
+            if sd.host_blob is not None:
+                arrays[f"hostblob_{uid}"] = np.asarray(sd.host_blob)
+            seqs.append(m)
+        kv = self.kv_config
+        meta = {
+            "kv": {"num_layers": kv.num_layers, "kv_heads": kv.kv_heads,
+                   "head_dim": kv.head_dim, "page_size": kv.page_size,
+                   "dtype": np.dtype(kv.dtype).name},
+            "prefix_caching": self.prefix_cache is not None,
+            "page_ids": page_order,
+            "sequences": seqs,
+            "prefix": [[d.hex(), int(p)] for d, p in prefix_entries],
+        }
+        return meta, arrays
+
+    def import_state(self, meta: dict, arrays: Dict[str, np.ndarray]
+                     ) -> None:
+        """Reconstruct exported state into THIS (empty) manager: fresh
+        device pages are allocated and scattered from the blob, block
+        tables are remapped onto them with the original refcounts
+        (shared prefix pages shared again, cache-retained pages parked
+        again), and the prefix index is rebuilt in its original LRU
+        order.  Raises :class:`SnapshotError` on geometry mismatch,
+        non-empty state, or a pool too small for the bundle."""
+        from ..snapshot import SnapshotError
+        alloc = self.kv_cache.allocator
+        if self._seqs or alloc.live_pages or alloc.parked_pages:
+            raise SnapshotError(
+                "import_state requires an empty state manager "
+                f"({len(self._seqs)} tracked sequences, "
+                f"{alloc.live_pages} live / {alloc.parked_pages} parked "
+                "pages)")
+        kv, cfg = meta["kv"], self.kv_config
+        ours = {"num_layers": cfg.num_layers, "kv_heads": cfg.kv_heads,
+                "head_dim": cfg.head_dim, "page_size": cfg.page_size,
+                "dtype": np.dtype(cfg.dtype).name}
+        if kv != ours:
+            raise SnapshotError(
+                f"KV geometry mismatch: bundle {kv} vs engine {ours}")
+        if bool(meta.get("prefix_caching")) != \
+                (self.prefix_cache is not None):
+            raise SnapshotError(
+                "prefix_caching mismatch between bundle and engine — "
+                "restore with the same serving config for a "
+                "deterministic resume")
+        old_ids = [int(p) for p in meta["page_ids"]]
+        if len(old_ids) > alloc.free_pages:
+            raise SnapshotError(
+                f"bundle needs {len(old_ids)} KV pages, pool has "
+                f"{alloc.free_pages} free")
+        mapping = {NULL_PAGE: NULL_PAGE}
+        if old_ids:
+            blob = arrays.get("page_blob")
+            if blob is None or blob.shape[1] != len(old_ids):
+                raise SnapshotError(
+                    "page blob missing or inconsistent with page_ids")
+            new = self.kv_cache.restore_pages(blob)     # refcount 1 each
+            mapping.update((o, int(n)) for o, n in zip(old_ids, new))
+        # reconstruct refcounts: allocate gave each page one reference;
+        # the block tables define the true count (0 = parked)
+        refs = Counter()
+        for m in meta["sequences"]:
+            for p in m["pages"]:
+                if p != NULL_PAGE:
+                    refs[int(p)] += 1
+        for old in old_ids:
+            n, newp = refs.get(old, 0), mapping[old]
+            if n == 0:
+                alloc.decref([newp])    # parked; indexed again below
+            elif n > 1:
+                alloc.add_ref([newp] * (n - 1))
+        for m in meta["sequences"]:
+            uid = int(m["uid"])
+            try:
+                pages = [mapping[int(p)] for p in m["pages"]]
+            except KeyError as e:
+                raise SnapshotError(
+                    f"sequence {uid} references unexported page {e}")
+            sd = SequenceDescriptor(
+                uid=uid, seen_tokens=int(m["seen_tokens"]), pages=pages,
+                live_slots=[int(i) for i in m["live_slots"]],
+                indexed_pages=int(m["indexed_pages"]),
+                last_digest=bytes.fromhex(m["last_digest"]))
+            if m["has_prompt"]:
+                sd.prompt_tokens = np.asarray(arrays[f"prompt_{uid}"],
+                                              np.int32)
+            if m["has_blob"]:
+                sd.host_blob = arrays[f"hostblob_{uid}"]
+                self._offload_blobs += 1
+                self._offload_bytes += sd.host_blob.nbytes
+            self._seqs[uid] = sd
+        if self.prefix_cache is not None:
+            for d_hex, p in meta["prefix"]:
+                newp = mapping.get(int(p))
+                if newp is None:
+                    raise SnapshotError(
+                        f"prefix index references unexported page {p}")
+                self.prefix_cache.insert(bytes.fromhex(d_hex), newp)
 
     # -- KV accounting ------------------------------------------------------
     def pages_needed(self, sd: SequenceDescriptor, n_new_tokens: int) -> int:
@@ -276,10 +452,13 @@ class StateManager:
     def check_invariants(self) -> None:
         """O(live pages) page-accounting audit:
         ``free + live + parked == total``, every block-table reference
-        is backed by exactly one allocator ref, and every parked page is
-        still prefix-cache indexed.  Raises RuntimeError on violation —
-        wired into FastGenScheduler.step under ``DS_KV_DEBUG=1`` so
-        scheduler changes can't silently leak or double-use pages."""
+        is backed by exactly one allocator ref, every parked page is
+        still prefix-cache indexed, and the offloaded-host-blob
+        counters match the tracked descriptors (a preempted request's
+        expiry must release its blob, ISSUE 8).  Raises RuntimeError on
+        violation — wired into FastGenScheduler.step under
+        ``DS_KV_DEBUG=1`` so scheduler changes can't silently leak or
+        double-use pages."""
         alloc = self.kv_cache.allocator
         refs = Counter()
         for sd in self._seqs.values():
@@ -305,6 +484,17 @@ class StateManager:
             raise RuntimeError(
                 f"KV invariant: free({alloc.free_pages}) + live({live}) "
                 f"+ cached({parked}) != total({alloc.total_pages})")
+        blobs = [sd for sd in self._seqs.values()
+                 if sd.host_blob is not None]
+        blob_bytes = sum(sd.host_blob.nbytes for sd in blobs)
+        if (len(blobs) != self._offload_blobs
+                or blob_bytes != self._offload_bytes):
+            raise RuntimeError(
+                f"KV invariant: offloaded-blob accounting drift — "
+                f"counters say {self._offload_blobs} blobs / "
+                f"{self._offload_bytes} bytes, descriptors hold "
+                f"{len(blobs)} / {blob_bytes} (a flushed preempted "
+                "sequence leaked its host blob?)")
         if parked:
             if self.prefix_cache is None:
                 raise RuntimeError(

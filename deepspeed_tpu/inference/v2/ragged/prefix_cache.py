@@ -140,6 +140,14 @@ class PrefixCache:
                 self._entries.move_to_end(d)
         return out
 
+    def export_entries(self) -> List[Tuple[bytes, int]]:
+        """Every (digest, page) binding in LRU order, oldest first —
+        the serving-snapshot serialization (ISSUE 8).  Re-importing via
+        ``insert`` in this order reproduces the eviction order exactly,
+        so a restored engine's cache behaves like the original under
+        pressure."""
+        return list(self._entries.items())
+
     def clear(self) -> List[int]:
         """Drop every entry; returns the pages that were indexed (the
         caller reclaims whichever of them are parked)."""

@@ -35,7 +35,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from ...runtime.fault_injection import (PoisonedRequestFault,
+from ...runtime.fault_injection import (InjectedPreemptionFault,
+                                        PoisonedRequestFault,
                                         get_fault_injector)
 from ...telemetry import get_tracer, trace_span
 from ...telemetry import metrics as tm
@@ -46,6 +47,9 @@ from ...utils.comms_logging import serving_counters
 from .engine import InferenceEngineV2
 from .ragged.blocked_allocator import KVAllocationError, NULL_PAGE
 from .sampling import SamplingParams, sample
+from .snapshot import (SNAPSHOT_VERSION, SnapshotError,
+                       maybe_install_drain_handler, read_bundle,
+                       write_bundle)
 
 
 @dataclasses.dataclass
@@ -93,6 +97,10 @@ class RequestError:
       isolated; the step loop kept serving the rest
     - ``"oom"``      — KV pool exhausted after the degradation ladder
       (evict parked pages -> preempt -> shed)
+    - ``"closing"``  — submitted after the scheduler stopped admission
+      (drain-for-snapshot / shutdown); resubmit to the restored replica
+    - ``"migrated"`` — the preemption grace budget expired before a
+      snapshot could be written; partial tokens kept (ISSUE 8)
 
     ``tokens`` holds whatever the request generated before
     termination."""
@@ -212,22 +220,45 @@ class FastGenScheduler:
         #: consecutive steps lost to KV-allocation failure (the
         #: degradation ladder escalates along this streak)
         self._oom_streak = 0
+        # -- preemption tolerance (ISSUE 8) ---------------------------
+        #: one-way latch: admission stopped (drain-for-snapshot or
+        #: shutdown); submit() fails fast with code="closing"
+        self._closed = False
+        self._snapshot_grace_s = float(
+            getattr(sv, "snapshot_grace_s", 5.0) or 0.0)
+        self._snapshot_path = str(getattr(sv, "snapshot_path", "") or "")
+        if self._snapshot_path:
+            # the real trigger: DS_DRAIN_ON_SIGTERM=1 wires SIGTERM
+            # (spot-VM preemption) to drain->snapshot on this scheduler
+            maybe_install_drain_handler(self, self._snapshot_path,
+                                        self._snapshot_grace_s)
 
     # -- request lifecycle ---------------------------------------------------
     def submit(self, uid: int, prompt: Sequence[int],
                params: Optional[SamplingParams] = None,
-               ttl_s: Optional[float] = None) -> None:
-        """Queue a request.  ``ttl_s`` (or the config's
-        ``default_ttl_s``) sets a deadline past which the request
-        terminates with a structured "expired" error instead of
-        hanging.  A bounded admission queue (``max_queue_depth``) or a
-        violated queue-wait SLO (``shed_queue_wait_ms``) sheds the
-        request immediately — check :attr:`errors` for the verdict."""
+               ttl_s: Optional[float] = None) -> Optional[RequestError]:
+        """Queue a request; returns None on acceptance or the
+        structured :class:`RequestError` verdict on immediate
+        rejection (also recorded in :attr:`errors`).  ``ttl_s`` (or the
+        config's ``default_ttl_s``) sets a deadline past which the
+        request terminates with a structured "expired" error instead
+        of hanging.  A bounded admission queue (``max_queue_depth``), a
+        violated queue-wait SLO (``shed_queue_wait_ms``), or a closed
+        scheduler (drain-for-snapshot/shutdown, code="closing") rejects
+        the request immediately."""
         req = Request(
             uid=uid, prompt=np.asarray(prompt, dtype=np.int32),
             params=params or SamplingParams())
         now = time.monotonic()
         req.submit_mono = now
+        if self._closed:
+            # a submit after close/drain-for-snapshot used to enqueue
+            # silently — onto a scheduler that will never run it and
+            # into no snapshot bundle.  Fail fast instead.
+            return self._reject_submit(
+                req, "closing",
+                "scheduler is draining for snapshot/shutdown — "
+                "resubmit to the restored replica")
         ttl = ttl_s if ttl_s is not None else (self._default_ttl_s
                                                or None)
         if ttl:
@@ -237,11 +268,10 @@ class FastGenScheduler:
             req.submit_s = time.perf_counter()
         if self._max_queue_depth and \
                 len(self._pending) >= self._max_queue_depth:
-            self._fail_request(
+            return self._reject_submit(
                 req, "shed",
                 f"admission queue full ({len(self._pending)} pending "
                 f">= max_queue_depth={self._max_queue_depth})")
-            return
         if self._shed_queue_wait_ms > 0.0 and self._pending:
             # SLO-driven load shedding.  The decisive signal is the
             # CURRENT backlog (oldest pending request already waited
@@ -256,14 +286,36 @@ class FastGenScheduler:
             if oldest_ms > self._shed_queue_wait_ms and (
                     h.count < 8
                     or h.percentile(90.0) > self._shed_queue_wait_ms):
-                self._fail_request(
+                return self._reject_submit(
                     req, "shed",
                     f"queue-wait SLO {self._shed_queue_wait_ms:.1f}ms "
                     f"violated (oldest pending {oldest_ms:.1f}ms, "
                     f"observed p90 {h.percentile(90.0):.1f}ms over "
                     f"{h.count} samples)")
-                return
         self._pending.append(req)
+        return None
+
+    def _reject_submit(self, req: Request, code: str,
+                       message: str) -> RequestError:
+        """Immediate admission rejection.  When the uid collides with a
+        LIVE request (a client retrying its own uid — the "closing"
+        message even invites a resubmit elsewhere), the live request
+        must NOT be evicted: it keeps its queue slot, KV pages, and
+        eventual verdict (it is exactly the state an in-progress
+        snapshot exists to capture).  Only the NEW submit is refused,
+        with an error record that is returned but not stored (storing
+        would clobber the live request's eventual verdict)."""
+        live = (req.uid in self._running or req.uid in self._preempted
+                or any(r.uid == req.uid for r in self._pending))
+        if live:
+            err = RequestError(uid=req.uid, code=code, message=message)
+            tm.FASTGEN_SHED.inc()
+            get_flight_recorder().record(
+                "request.error", uid=req.uid, code=code,
+                message=message[:200], tokens=0, duplicate=True)
+            return err
+        self._fail_request(req, code, message)
+        return self.errors.get(req.uid)
 
     def _fail_request(self, req: Request, code: str,
                       message: str) -> None:
@@ -285,10 +337,14 @@ class FastGenScheduler:
             # bounded retention on a long-lived scheduler: drop the
             # oldest verdicts (dict preserves insertion order)
             self.errors.pop(next(iter(self.errors)))
-        if code == "shed":
+        if code in ("shed", "closing"):
+            # "closing" IS admission control: the valve is the
+            # scheduler's lifecycle instead of queue depth
             tm.FASTGEN_SHED.inc()
         elif code == "expired":
             tm.FASTGEN_EXPIRED.inc()
+        elif code == "migrated":
+            tm.FASTGEN_MIGRATED.inc()
         else:
             tm.FASTGEN_REQUEST_ERROR.inc()
         get_flight_recorder().record(
@@ -482,6 +538,15 @@ class FastGenScheduler:
         sequence whose token became host-visible this step (with
         async_scheduling that is the PREVIOUS step's tokens — one-step
         lag)."""
+        _faults = get_fault_injector()
+        if _faults.armed and _faults.fire("serving.preempt"):
+            # deterministic SIGTERM-equivalent at a step BOUNDARY
+            # (nothing mid-mutation; raised before the crash-forensics
+            # wrapper because a controlled preemption is not a crash).
+            # The caller handles it like the real signal: catch, run
+            # drain_and_snapshot, restore elsewhere.
+            raise InjectedPreemptionFault(
+                "injected preemption between scheduler steps")
         try:
             if _telemetry.enabled:
                 # spans from this step (and everything nested under it)
@@ -881,6 +946,221 @@ class FastGenScheduler:
                     "allocation failures)")
                 self._preempted_this_step = True
         self.last_step_scheduled = 0
+
+    # -- live engine snapshot / deterministic restore (ISSUE 8) --------------
+    def close(self) -> None:
+        """Stop admission permanently (one-way): every later
+        ``submit()`` terminates immediately with a structured
+        ``RequestError(code="closing")``.  Called first on the
+        snapshot path — a scheduler being serialized must not accept
+        work the bundle won't contain."""
+        self._closed = True
+
+    @staticmethod
+    def _serialize_request(req: Request, now: float) -> dict:
+        p = req.params
+        return {"uid": int(req.uid),
+                "prompt": np.asarray(req.prompt).tolist(),
+                "prompt_sent": int(req.prompt_sent),
+                "generated": [int(t) for t in req.generated],
+                "prefix_checked": bool(req.prefix_checked),
+                "params": {"temperature": float(p.temperature),
+                           "top_k": int(p.top_k),
+                           "top_p": float(p.top_p),
+                           "max_new_tokens": int(p.max_new_tokens),
+                           "stop_token": (None if p.stop_token is None
+                                          else int(p.stop_token))},
+                # deadlines are monotonic-clock absolute — only the
+                # REMAINING budget survives a process boundary
+                "ttl_remaining_s": (None if req.deadline is None
+                                    else req.deadline - now)}
+
+    def _restore_request(self, d: dict, now: float) -> Request:
+        pr = d["params"]
+        req = Request(
+            uid=int(d["uid"]),
+            prompt=np.asarray(d["prompt"], dtype=np.int32),
+            params=SamplingParams(
+                temperature=float(pr["temperature"]),
+                top_k=int(pr["top_k"]), top_p=float(pr["top_p"]),
+                max_new_tokens=int(pr["max_new_tokens"]),
+                stop_token=(None if pr["stop_token"] is None
+                            else int(pr["stop_token"]))),
+            prompt_sent=int(d["prompt_sent"]),
+            generated=[int(t) for t in d["generated"]],
+            prefix_checked=bool(d["prefix_checked"]))
+        # latency/SLO stamps are process-relative and deliberately not
+        # captured; the shed valve's always-on stamp restarts here
+        req.submit_mono = now
+        ttl = d.get("ttl_remaining_s")
+        if ttl is not None:
+            req.deadline = now + float(ttl)
+            self._has_deadlines = True
+        return req
+
+    def snapshot(self, path: Optional[str] = None,
+                 on_token: Optional[Callable[[int, int], None]] = None
+                 ) -> dict:
+        """Drain to committed state and serialize everything needed to
+        resume generation **tokenwise identical** to the uninterrupted
+        run: pending/running/preempted requests (prompts, committed
+        tokens, sampling params, remaining TTLs), the scheduler RNG key
+        data, every referenced KV page's contents (shared prefix pages
+        written once, refcounts reconstructed at restore), the
+        prefix-cache digest index in LRU order, scheduler counters, and
+        the structured error log.  Admission is closed first (later
+        submits fail with code="closing").  ``on_token`` receives the
+        tokens the final drain commits — a request COMPLETING at that
+        drain leaves the scheduler and is not in the bundle, so this
+        callback is its only delivery path (zero committed tokens
+        lost).  Returns the bundle as ``{"meta", "arrays"}``; with
+        ``path`` also writes the atomic, versioned, checksummed
+        on-disk bundle (``snapshot.py``)."""
+        t0 = time.perf_counter()
+        self.close()
+        with trace_span("fastgen.snapshot"):
+            self._drain(on_token)   # commit the in-flight chained step
+            now = time.monotonic()
+            eng_meta, arrays = self._engine.state_manager.export_state()
+            arrays["rng_key"] = np.asarray(
+                jax.random.key_data(self._rng))
+            meta = {
+                "version": SNAPSHOT_VERSION,
+                "requests": {
+                    "pending": [self._serialize_request(r, now)
+                                for r in self._pending],
+                    "running": [self._serialize_request(r, now)
+                                for r in self._running.values()],
+                    "preempted": [self._serialize_request(r, now)
+                                  for r in self._preempted.values()],
+                },
+                "counters": {
+                    "step_ordinal": int(self._step_ordinal),
+                    "last_step_scheduled": int(self.last_step_scheduled),
+                    "oom_streak": int(self._oom_streak),
+                },
+                "errors": [dataclasses.asdict(e)
+                           for e in self.errors.values()],
+                "engine": eng_meta,
+            }
+            if path is not None:
+                write_bundle(path, meta, arrays)
+        ms = (time.perf_counter() - t0) * 1e3
+        # counted even telemetry-off (ServingCounters convention):
+        # snapshots are rare and operationally load-bearing
+        tm.FASTGEN_SNAPSHOT_MS.observe(ms)
+        get_flight_recorder().record(
+            "fastgen.snapshot",
+            requests=(len(self._pending) + len(self._running)
+                      + len(self._preempted)),
+            pages=len(eng_meta["page_ids"]), ms=round(ms, 2),
+            path=path or "")
+        return {"meta": meta, "arrays": arrays}
+
+    def restore(self, bundle) -> "FastGenScheduler":
+        """Reconstruct a snapshotted scheduler into THIS freshly-built
+        one (fresh engine — same process or a new one — with the same
+        model weights and serving config) and resume tokenwise
+        identical to the uninterrupted run, with restored full pages
+        re-attached to the prefix cache so warm-TTFT survives the
+        restart.  ``bundle`` is a path or the dict ``snapshot()``
+        returned.  Raises :class:`SnapshotError` on a corrupt/
+        truncated/version-mismatched bundle or a non-fresh target —
+        never a hang, never silent partial state."""
+        t0 = time.perf_counter()
+        with trace_span("fastgen.restore"):
+            if isinstance(bundle, (str, os.PathLike)):
+                meta, arrays = read_bundle(os.fspath(bundle))
+            else:
+                meta, arrays = bundle["meta"], bundle["arrays"]
+                if meta.get("version") != SNAPSHOT_VERSION:
+                    raise SnapshotError(
+                        f"unsupported snapshot version "
+                        f"{meta.get('version')!r}")
+            if (self._pending or self._running or self._preempted
+                    or self._inflight is not None or self._closed):
+                raise SnapshotError(
+                    "restore requires a fresh scheduler (this one has "
+                    "queued work or is closed)")
+            self._engine.state_manager.import_state(meta["engine"],
+                                                    arrays)
+            import jax.numpy as jnp
+            self._rng = jax.random.wrap_key_data(
+                jnp.asarray(arrays["rng_key"], jnp.uint32))
+            now = time.monotonic()
+            reqs = meta["requests"]
+            self._pending = [self._restore_request(d, now)
+                             for d in reqs["pending"]]
+            self._running = {int(d["uid"]): self._restore_request(d, now)
+                             for d in reqs["running"]}
+            self._preempted = {int(d["uid"]):
+                               self._restore_request(d, now)
+                               for d in reqs["preempted"]}
+            c = meta["counters"]
+            self._step_ordinal = int(c["step_ordinal"])
+            self.last_step_scheduled = int(c["last_step_scheduled"])
+            self._oom_streak = int(c["oom_streak"])
+            self.errors = {
+                int(e["uid"]): RequestError(
+                    uid=int(e["uid"]), code=e["code"],
+                    message=e["message"],
+                    tokens=[int(t) for t in e["tokens"]])
+                for e in meta["errors"]}
+        tm.FASTGEN_RESTORE.inc()
+        get_flight_recorder().record(
+            "fastgen.restore",
+            requests=(len(self._pending) + len(self._running)
+                      + len(self._preempted)),
+            pages=len(meta["engine"]["page_ids"]),
+            ms=round((time.perf_counter() - t0) * 1e3, 2))
+        if self._kv_debug:
+            self._engine.state_manager.check_invariants()
+        return self
+
+    def drain_and_snapshot(self, path: str,
+                           grace_s: Optional[float] = None,
+                           on_token: Optional[Callable[[int, int],
+                                                       None]] = None
+                           ) -> Optional[str]:
+        """The SIGTERM body (spot-VM preemption): stop admission,
+        finish/drain the in-flight chained step (tokens delivered via
+        ``on_token``), and snapshot to ``path`` within the grace budget
+        (``snapshot_grace_s``).  Returns ``path`` when the bundle was
+        written; if the budget expired first (or the write failed
+        terminally), every live request is converted to a structured
+        ``RequestError(code="migrated")`` with its partial tokens kept,
+        and None is returned — clients get a verdict either way."""
+        grace = (self._snapshot_grace_s if grace_s is None
+                 else float(grace_s))
+        deadline = time.monotonic() + grace
+        self.close()
+        from ...utils.logging import logger
+        try:
+            self._drain(on_token)
+        except Exception as e:    # the device may already be wedged
+            logger.warning("drain_and_snapshot: drain failed (%s: %s)",
+                           type(e).__name__, e)
+        if time.monotonic() < deadline:
+            try:
+                self.snapshot(path, on_token)
+                return path
+            except Exception as e:
+                logger.warning(
+                    "drain_and_snapshot: snapshot failed (%s: %s)",
+                    type(e).__name__, e)
+        else:
+            logger.warning(
+                "drain_and_snapshot: grace budget %.2fs expired before "
+                "a snapshot could be written", grace)
+        live = (list(self._pending) + list(self._running.values())
+                + list(self._preempted.values()))
+        for req in live:
+            self._fail_request(
+                req, "migrated",
+                f"preemption grace budget ({grace:.2f}s) expired "
+                "before a snapshot could be written "
+                f"({len(req.generated)} partial tokens kept)")
+        return None
 
     # -- convenience ---------------------------------------------------------
     def run_to_completion(self) -> Dict[int, List[int]]:

@@ -444,6 +444,14 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
     #: on a would-be scheduler deadlock, shed the most demanding
     #: request with a structured "oom" error instead of raising
     shed_unservable: bool = False
+    # -- preemption tolerance (ISSUE 8) --------------------------------
+    #: grace budget in seconds for the SIGTERM drain->snapshot path;
+    #: past it live requests terminate with a structured "migrated"
+    #: error instead of vanishing
+    snapshot_grace_s: float = 5.0
+    #: bundle path the SIGTERM handler writes (with
+    #: DS_DRAIN_ON_SIGTERM=1); empty = explicit snapshot() calls only
+    snapshot_path: str = ""
 
     def to_v2_dict(self) -> Dict[str, Any]:
         """The ``serving_optimization`` dict the inference-v2 config
@@ -455,7 +463,9 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
                 "max_queue_depth": self.max_queue_depth,
                 "shed_queue_wait_ms": self.shed_queue_wait_ms,
                 "default_ttl_s": self.default_ttl_s,
-                "shed_unservable": self.shed_unservable}
+                "shed_unservable": self.shed_unservable,
+                "snapshot_grace_s": self.snapshot_grace_s,
+                "snapshot_path": self.snapshot_path}
 
 
 class TPUConfig(DeepSpeedConfigModel):

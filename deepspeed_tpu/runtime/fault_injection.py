@@ -26,6 +26,10 @@ Sites (the registry refuses unknown names so a typo'd spec is loud):
                            allocation path
 ``fastgen.poison_request``  raise :class:`PoisonedRequestFault` inside ONE
                            request's admission path (isolation food)
+``serving.preempt``        raise :class:`InjectedPreemptionFault` — a
+                           deterministic SIGTERM-equivalent — between
+                           scheduler steps, so the drain→snapshot→restore
+                           preemption path is chaos-testable without signals
 =========================  ==================================================
 
 Arming: the ``fault_injection`` config block on either engine config, or
@@ -82,6 +86,14 @@ class PoisonedRequestFault(InjectedFault):
     inside that request's admission block)."""
 
 
+class InjectedPreemptionFault(InjectedFault):
+    """A SIGTERM-equivalent preemption raised BETWEEN scheduler steps
+    (no step state is mid-mutation).  The driving loop catches it and
+    runs ``drain_and_snapshot`` exactly as the real signal handler
+    would — deterministic, so a chaos test can interrupt at any chosen
+    step ordinal and assert tokenwise parity after restore."""
+
+
 #: every known injection site -> short description (docs + validation)
 SITES: Dict[str, str] = {
     "train.nan_grad": "poison the next train batch with NaNs",
@@ -92,6 +104,8 @@ SITES: Dict[str, str] = {
     "kv.alloc_oom": "raise KVAllocationError from KV-page allocation",
     "fastgen.poison_request":
         "raise inside one serving request's admission path",
+    "serving.preempt":
+        "raise a SIGTERM-equivalent preemption between scheduler steps",
 }
 
 

@@ -142,6 +142,18 @@ KV_ALLOC_FAIL = registry.counter(
     "ds_kv_alloc_fail_total",
     "KV-page allocation failures absorbed by the degradation ladder")
 
+# -- preemption-tolerant serving (ISSUE 8) -----------------------------------
+FASTGEN_SNAPSHOT_MS = registry.histogram(
+    "ds_fastgen_snapshot_ms",
+    "drain + serialize wall time of a serving state snapshot")
+FASTGEN_RESTORE = registry.counter(
+    "ds_fastgen_restore_total",
+    "serving snapshot bundles restored into a fresh engine")
+FASTGEN_MIGRATED = registry.counter(
+    "ds_fastgen_migrated_total",
+    "requests terminated with code=migrated because the preemption "
+    "grace budget expired before a snapshot was written")
+
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(
     "ds_fastgen_ttft_ms", "time to first token, submit -> host-visible")

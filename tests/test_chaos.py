@@ -151,7 +151,7 @@ class TestFaultInjectorRegistry:
         assert set(SITES) == {
             "train.nan_grad", "train.slow_step",
             "comm.collective_failure", "ckpt.io_error", "kv.alloc_oom",
-            "fastgen.poison_request"}
+            "fastgen.poison_request", "serving.preempt"}
 
 
 # ---------------------------------------------------------------------------

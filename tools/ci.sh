@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Single CI entrypoint (ISSUE 8 satellite).  Runs, in order:
+#
+#   1. tier-1        — the ROADMAP verify tier (-m 'not slow'; includes
+#                      the heavy tier and the chaos suite)
+#   2. chaos tier    — every fault-injection test alone (-m chaos), so
+#                      a chaos regression is named even when tier-1's
+#                      summary is long
+#   3. metric lint   — tools/check_metrics.py (naming convention +
+#                      DESIGN.md documentation for every ds_* metric)
+#   4. bench gate    — tools/check_bench.py --strict (latest vs
+#                      previous BENCH_r*.json; throughput -10% /
+#                      latency +15% tolerances, cross-backend rounds
+#                      downgraded to notes)
+#
+# Usage: tools/ci.sh [extra pytest args for the tier-1 leg]
+# Environment: JAX_PLATFORMS defaults to cpu (the CI mesh);
+#              DS_CI_TIMEOUT (seconds, default 870) bounds tier-1.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+TIMEOUT="${DS_CI_TIMEOUT:-870}"
+
+echo "== tier-1 (timeout ${TIMEOUT}s) =="
+timeout -k 10 "$TIMEOUT" python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly "$@"
+
+echo "== chaos tier =="
+python -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+echo "== metric namespace lint =="
+python tools/check_metrics.py
+
+echo "== bench regression gate =="
+python tools/check_bench.py --strict
+
+echo "ci.sh: all gates green"
