@@ -345,6 +345,9 @@ def bench_fastgen(jax):
                 # the prefix leg may have bound the ds_kv_* gauges to
                 # its dedicated engine — rebind to the measured one
                 eng._bind_kv_gauges()
+                # cost/MFU window (ISSUE 9): re-open at the measured
+                # run so the warmups' dispatches don't dilute the rate
+                eng.model.reset_cost_window()
                 was_enabled = telemetry.enabled()
                 telemetry.enable()
                 try:
@@ -363,6 +366,17 @@ def bench_fastgen(jax):
                     tmet.FASTGEN_STEP_CACHE_MISS.value
                 result["fastgen_compile_on_path_total"] = \
                     tmet.FASTGEN_COMPILE_ON_PATH.value
+                # hardware denominator (ISSUE 9): dispatched-program
+                # FLOPs / wall / peak over the measured window (read
+                # IMMEDIATELY — the gauge is wall-relative and decays
+                # once serving stops)
+                cs = eng.cost_summary()
+                result["fastgen_mfu"] = round(float(cs["mfu"]), 8)
+                result["fastgen_hbm_gb_s"] = round(
+                    cs["bytes_per_s"] / 1e9, 3)
+                result["fastgen_program_flops_p50"] = float(np.median(
+                    [c["flops"] for c in cs["programs"].values()]
+                    or [0.0]))
                 # goodput (ISSUE 5): stamped by the training leg's
                 # telemetry-on coda at its own wall-clock moment.  When
                 # no coda ran AND the gauge was never bound, OMIT the
@@ -515,6 +529,37 @@ def bench_fastgen(jax):
                 sys.stderr.write(f"bench: fastgen chaos leg failed: "
                                  f"{e}\n")
                 result["fastgen_chaos_error"] = str(e)[:300]
+        if os.environ.get("BENCH_REPLAY", "0") != "0":
+            # replay leg (ISSUE 9): drive the checked-in 200-request
+            # sample trace through tools/replay_trace.py — anonymized
+            # prompts reproducing the recorded length / prefix-sharing
+            # structure, untimed shape warmup, then a measured
+            # full-speed replay.  replay_compile_on_path_total is the
+            # ROADMAP item 5 success metric over a replayed trace (0 =
+            # the warmed lattice covered everything the trace forms).
+            # Off by default (headline legs stay comparable); own try.
+            try:
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                from tools.replay_trace import run_replay
+                trace_path = os.environ.get(
+                    "BENCH_REPLAY_TRACE",
+                    os.path.join(os.path.dirname(os.path.abspath(
+                        __file__)), "tools", "traces",
+                        "sample_200.jsonl"))
+                out = run_replay(trace_path)
+                rep = out["replay"]
+                result["replay_requests"] = rep["requests_submitted"]
+                result["replay_ttft_p50_ms"] = rep["ttft_p50_ms"]
+                result["replay_decode_tok_s"] = rep["decode_tok_s"]
+                result["replay_compile_on_path_total"] = \
+                    rep["compile_on_path"]
+                result["replay_structural_ok"] = \
+                    out["diff"]["structural_ok"]
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: fastgen replay leg failed: "
+                                 f"{e}\n")
+                result["fastgen_replay_error"] = str(e)[:300]
         return result
     except Exception as e:  # noqa: BLE001 — aux leg must not kill the bench
         sys.stderr.write(f"bench: fastgen leg failed: {e}\n")

@@ -87,8 +87,10 @@ class TelemetryConfig:
     (``DS_TELEMETRY`` / ``telemetry.enable()``); ``metrics_port``
     starts the Prometheus endpoint (0 = off); ``trace_buffer`` resizes
     the span ring (0 = keep current capacity).  ISSUE 5 watchdog /
-    flight-recorder knobs follow the same keep-current convention
-    (see the runtime config's ``TelemetryConfig`` for semantics)."""
+    flight-recorder knobs and the ISSUE 9 workload-trace knobs
+    (``workload_trace_path`` / ``workload_trace_max_mb``) follow the
+    same keep-current convention (see the runtime config's
+    ``TelemetryConfig`` for semantics)."""
     enabled: Optional[bool] = None
     metrics_port: int = 0
     trace_buffer: int = 0
@@ -97,6 +99,8 @@ class TelemetryConfig:
     watchdog_warmup: int = -1
     postmortem_dir: str = ""
     flight_recorder_events: int = 0
+    workload_trace_path: str = ""
+    workload_trace_max_mb: int = 0
 
     def apply(self) -> None:
         from ...telemetry import apply_settings
@@ -105,7 +109,9 @@ class TelemetryConfig:
                        watchdog_threshold=self.watchdog_threshold,
                        watchdog_warmup=self.watchdog_warmup,
                        postmortem_dir=self.postmortem_dir,
-                       flight_recorder_events=self.flight_recorder_events)
+                       flight_recorder_events=self.flight_recorder_events,
+                       workload_trace_path=self.workload_trace_path,
+                       workload_trace_max_mb=self.workload_trace_max_mb)
 
 
 @dataclasses.dataclass

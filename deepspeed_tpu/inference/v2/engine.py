@@ -47,6 +47,68 @@ class SchedulingError(RuntimeError):
         self.result = result
 
 
+def lattice_keys(max_prompt: int, max_new_tokens: int,
+                 max_concurrency: int, page_size: int,
+                 max_ragged_batch_size: int, has_fresh: bool,
+                 sampling: bool) -> List[Tuple]:
+    """Every (S, Q, P[, fresh[, kind, ...]]) step-cache key the default
+    power-of-two bucket lattice contains for this geometry — the ONE
+    enumeration shared by ``InferenceEngineV2.precompile`` (which
+    compiles it) and ``tools/analyze_trace.py`` (which reports observed
+    traffic's coverage against it), so the two can't drift (ROADMAP
+    item 5's single lattice authority)."""
+    from .ragged.batch import MIN_PAGES, MIN_SLOTS, _bucket
+
+    s_vals, q_vals, p_vals = [], [1], []
+    s = _bucket(1, MIN_SLOTS)
+    while s <= _bucket(max_concurrency, MIN_SLOTS):
+        s_vals.append(s)
+        s *= 2
+    q = 2
+    while q <= _bucket(max_prompt):
+        q_vals.append(q)
+        q *= 2
+    total = max_prompt + max_new_tokens  # decode growth headroom
+    max_pages_needed = _bucket(-(-total // page_size), MIN_PAGES)
+    p = _bucket(1, MIN_PAGES)
+    while p <= max_pages_needed:
+        p_vals.append(p)
+        p *= 2
+
+    keys: List[Tuple] = []
+    for S in s_vals:
+        for Q in q_vals:
+            if S * Q > max_ragged_batch_size:
+                continue
+            for P in p_vals:
+                if P * page_size < Q:  # bucket can't hold its own tokens
+                    continue
+                # Q>1 buckets exist in both variants: fresh prefill
+                # (flash path) and continued prefill (paged path) — but
+                # only when the model HAS a fresh implementation (ALiBi
+                # models ignore the flag; compiling the True variant
+                # would duplicate every prefill executable)
+                for fresh in ((False, True) if Q > 1 and has_fresh
+                              else (False,)):
+                    key = (S, Q, P, fresh)
+                    keys.append(key)
+                    if not sampling:
+                        continue
+                    for greedy in (True, False):
+                        keys.append(key + ("sample", greedy))
+                        if Q == 1 and not fresh:
+                            # double-buffer chain: the previous step's
+                            # slot bucket can only be >= this one's
+                            # (chained rows are a subset of the
+                            # previous step's rows)
+                            for prev_s in s_vals:
+                                if prev_s < S:
+                                    continue
+                                keys.append((S, 1, P, False, "chain",
+                                             prev_s, greedy))
+    return keys
+
+
 class InferenceEngineV2:
     def __init__(self, model: RaggedInferenceModel,
                  config: Optional[RaggedInferenceEngineConfig] = None):
@@ -139,69 +201,19 @@ class InferenceEngineV2:
         sample variants (greedy + stochastic) and, for decode buckets,
         the chained double-buffer step — the FastGenScheduler's hot path
         when serving_optimization is on.  Returns the compiled keys."""
-        from .ragged.batch import MIN_PAGES, MIN_SLOTS, _bucket
         sm = self._config.state_manager
-        max_concurrency = max_concurrency or sm.max_ragged_sequence_count
-        page = self._model.kv_config.page_size
-        # floors shared with build_batch via the exported module
-        # constants — the lattice can't drift from the live path
-        min_slots, min_pages = MIN_SLOTS, MIN_PAGES
-
-        s_vals, q_vals, p_vals = [], [1], []
-        s = _bucket(1, min_slots)
-        while s <= _bucket(max_concurrency, min_slots):
-            s_vals.append(s)
-            s *= 2
-        q = 2
-        while q <= _bucket(max_prompt):
-            q_vals.append(q)
-            q *= 2
-        total = max_prompt + max_new_tokens  # decode growth headroom
-        max_pages_needed = _bucket(-(-total // page), min_pages)
-        p = _bucket(1, min_pages)
-        while p <= max_pages_needed:
-            p_vals.append(p)
-            p *= 2
-
         kv = self._state.kv_cache.data
-        keys = []
-        for S in s_vals:
-            for Q in q_vals:
-                if S * Q > sm.max_ragged_batch_size:
-                    continue
-                for P in p_vals:
-                    if P * page < Q:  # bucket can't hold its own tokens
-                        continue
-                    # Q>1 buckets exist in both variants: fresh prefill
-                    # (flash path) and continued prefill (paged path) —
-                    # but only when the model HAS a fresh implementation
-                    # (ALiBi models ignore the flag; compiling the True
-                    # variant would duplicate every prefill executable)
-                    has_fresh = getattr(self._model, "_fresh_attention",
-                                        None) is not None
-                    for fresh in ((False, True) if Q > 1 and has_fresh
-                                  else (False,)):
-                        key = (S, Q, P, fresh)
-                        self._model.precompile_step(key, kv)
-                        keys.append(key)
-                        if not sampling:
-                            continue
-                        for greedy in (True, False):
-                            skey = key + ("sample", greedy)
-                            self._model.precompile_step(skey, kv)
-                            keys.append(skey)
-                            if Q == 1 and not fresh:
-                                # double-buffer chain: the previous
-                                # step's slot bucket can only be >= this
-                                # one's (chained rows are a subset of
-                                # the previous step's rows)
-                                for prev_s in s_vals:
-                                    if prev_s < S:
-                                        continue
-                                    ckey = (S, 1, P, False, "chain",
-                                            prev_s, greedy)
-                                    self._model.precompile_step(ckey, kv)
-                                    keys.append(ckey)
+        keys = lattice_keys(
+            max_prompt=max_prompt, max_new_tokens=max_new_tokens,
+            max_concurrency=(max_concurrency
+                             or sm.max_ragged_sequence_count),
+            page_size=self._model.kv_config.page_size,
+            max_ragged_batch_size=sm.max_ragged_batch_size,
+            has_fresh=getattr(self._model, "_fresh_attention",
+                              None) is not None,
+            sampling=sampling)
+        for key in keys:
+            self._model.precompile_step(key, kv)
         if strict:
             self._model.strict_shapes = True
         return keys
@@ -234,6 +246,11 @@ class InferenceEngineV2:
     def seen_tokens(self, uid: int) -> int:
         sd = self._state.get_sequence(uid)
         return sd.seen_tokens if sd is not None else 0
+
+    def cost_summary(self) -> Dict:
+        """Per-program flops/bytes table + window MFU / bytes-per-s
+        (ISSUE 9): serving throughput's hardware denominator."""
+        return self._model.cost_summary()
 
     # -- scheduling queries --------------------------------------------------
     def query(self, uid: int, max_request_tokens: int,

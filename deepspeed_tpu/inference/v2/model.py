@@ -15,6 +15,8 @@ the KV cache is donated so decoding is allocation-free on device.
 from __future__ import annotations
 
 import functools
+import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -25,8 +27,26 @@ from ...models import transformer as T
 from ...ops.paged_attention import (gather_last, paged_attention,
                                     rope_write_kv, token_positions,
                                     write_kv)
+from ...telemetry import metrics as tm
 from ...telemetry.watchdog import get_watchdog
+from ...telemetry.workload_trace import get_workload_trace
 from .ragged import KVCacheConfig, RaggedBatch
+
+
+def serving_peak_flops() -> float:
+    """Peak FLOP/s denominator for the serving MFU gauge:
+    ``DS_PEAK_FLOPS`` env wins, else the device table
+    (profiling.flops_profiler), else the TPU v5e bf16 number — the
+    gauge always has a denominator, and which one is a config fact the
+    operator controls."""
+    env = os.environ.get("DS_PEAK_FLOPS", "")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    from ...profiling.flops_profiler import _device_peak_flops
+    return _device_peak_flops() or 197e12
 
 
 def _rebox_from_cfg(cfg: T.TransformerConfig, params):
@@ -168,6 +188,15 @@ class RaggedInferenceModel:
             params = T.meta.unbox(params) if T._has_boxes(params) else params
         self.params = params
         self._step_cache: Dict[Tuple[int, int, int], Callable] = {}
+        # -- per-program cost accounting (ISSUE 9): flops/bytes from
+        # compiled.cost_analysis() per step-cache key, accumulated per
+        # dispatch so serving throughput gets a hardware denominator
+        # (ds_fastgen_program_flops / ds_fastgen_mfu)
+        self._program_costs: Dict[tuple, Dict[str, float]] = {}
+        self._flops_dispatched = 0.0
+        self._bytes_dispatched = 0.0
+        self._cost_t0: Optional[float] = None
+        self._cost_gauges_bound = False
 
     # -- weight-only quantization ------------------------------------------
     def quantize_weights(self, fmt: str = "fp8_e4m3") -> None:
@@ -221,6 +250,7 @@ class RaggedInferenceModel:
         self.params = dict(self.params, layers=layers)
         self._quantized_fmt = fmt
         self._step_cache.clear()
+        self._program_costs.clear()   # quantized programs re-cost
 
     # -- sharding of the KV cache ------------------------------------------
     def kv_sharding(self) -> Optional[jax.sharding.Sharding]:
@@ -336,11 +366,120 @@ class RaggedInferenceModel:
                     "strict_shapes.")
             get_watchdog().note_step_cache(hit=False, key=key,
                                            compiled_on_path=True)
-            fn = jax.jit(self._impl_of(key), donate_argnums=(1,))
-            self._step_cache[key] = fn
+
+            # AOT-compile at the first call (the caller's concrete args
+            # ARE this key's avals — shapes are fully determined by the
+            # key) instead of caching a lazily-compiling jit wrapper:
+            # identical executable, but the COMPILED object is in hand,
+            # so on-path compiles feed the same cost_analysis()
+            # accounting as the precompiled lattice (ISSUE 9)
+            def compile_on_call(*args, _key=key):
+                compiled = jax.jit(
+                    self._impl_of(_key),
+                    donate_argnums=(1,)).lower(*args).compile()
+                self._note_program_cost(_key, compiled)
+                # _get_step already accounted this dispatch, but the
+                # cost was unknown then — bill it now so on-path and
+                # precompiled keys agree from dispatch 1
+                self._account_cost(_key)
+                self._step_cache[_key] = compiled
+                return compiled(*args)
+
+            self._step_cache[key] = compile_on_call
+            fn = compile_on_call
         else:
             get_watchdog().note_step_cache(hit=True)
+        self._account_dispatch(key)
         return fn
+
+    # -- per-program cost / MFU accounting (ISSUE 9) -------------------------
+    def _note_program_cost(self, key, compiled) -> None:
+        """Capture flops / bytes-accessed of one compiled executable
+        (post-fusion HLO, the flops_profiler convention).  Best-effort:
+        a backend without cost_analysis leaves the key unaccounted."""
+        try:
+            cost = compiled.cost_analysis() or {}
+        except Exception:
+            return
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        self._program_costs[key] = {
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes": float(cost.get("bytes accessed", 0.0)),
+        }
+
+    def _account_dispatch(self, key) -> None:
+        """One program dispatch of ``key`` (every forward/sample/chain/
+        mixed call funnels through ``_get_step`` exactly once): feed the
+        workload trace's key-occupancy summary and the cost window
+        behind the ds_fastgen_program_flops / _mfu gauges.  Always-on
+        (ServingCounters convention): a dict lookup + float adds."""
+        wt = get_workload_trace()
+        if wt.active:
+            wt.note_step_key(key)
+        self._account_cost(key)
+
+    def _account_cost(self, key) -> None:
+        cost = self._program_costs.get(key)
+        if cost is None:
+            return
+        if self._cost_t0 is None:
+            self._cost_t0 = time.perf_counter()
+        self._flops_dispatched += cost["flops"]
+        self._bytes_dispatched += cost["bytes"]
+        tm.FASTGEN_PROGRAM_FLOPS.set(cost["flops"])
+        tm.FASTGEN_PROGRAM_BYTES.set(cost["bytes"])
+        if not self._cost_gauges_bound:
+            self._bind_cost_gauges()
+
+    def _bind_cost_gauges(self) -> None:
+        """Bind the rate gauges once costs exist.  Wall-relative (like
+        ds_train_goodput_ratio): the window opens at the first costed
+        dispatch and reading long after serving stopped dilutes the
+        rate — ``reset_cost_window()`` re-opens it for a measured
+        window.  Weakref: the registry must not keep a discarded model
+        (and its params) alive."""
+        self._cost_gauges_bound = True
+        import weakref
+        ref = weakref.ref(self)
+        peak = serving_peak_flops()
+
+        def rate(attr, scale=1.0):
+            def _read(r=ref, a=attr, s=scale):
+                m = r()
+                if m is None or m._cost_t0 is None:
+                    return 0.0
+                wall = max(time.perf_counter() - m._cost_t0, 1e-9)
+                return getattr(m, a) / wall / s
+            return _read
+
+        tm.FASTGEN_MFU.bind(rate("_flops_dispatched", peak))
+        tm.FASTGEN_BYTES_PER_S.bind(rate("_bytes_dispatched"))
+
+    def reset_cost_window(self) -> None:
+        """Re-open the MFU/bytes-per-s window (bench measured-window
+        control); the per-key cost table survives."""
+        self._flops_dispatched = 0.0
+        self._bytes_dispatched = 0.0
+        self._cost_t0 = None
+
+    def cost_summary(self) -> Dict[str, Any]:
+        """Per-program cost table + window totals — the serving
+        analogue of the training flops profiler's report."""
+        wall = (max(time.perf_counter() - self._cost_t0, 1e-9)
+                if self._cost_t0 is not None else 0.0)
+        peak = serving_peak_flops()
+        return {
+            "programs": {repr(k): dict(v)
+                         for k, v in self._program_costs.items()},
+            "flops_dispatched": self._flops_dispatched,
+            "bytes_dispatched": self._bytes_dispatched,
+            "window_s": wall,
+            "peak_flops": peak,
+            "mfu": (self._flops_dispatched / wall / peak if wall else 0.0),
+            "bytes_per_s": (self._bytes_dispatched / wall if wall
+                            else 0.0),
+        }
 
     def _fresh_of(self, key) -> bool:
         return bool(key[3]) if len(key) > 3 else False
@@ -405,8 +544,9 @@ class RaggedInferenceModel:
         # the COMPILED executable goes into the cache: later calls with
         # the bucket's exact shapes dispatch straight to it (jit's own
         # dispatch cache is not populated by AOT lowering)
-        self._step_cache[key] = fn.lower(
-            *self._step_avals(key, kv_aval)).compile()
+        compiled = fn.lower(*self._step_avals(key, kv_aval)).compile()
+        self._note_program_cost(key, compiled)
+        self._step_cache[key] = compiled
 
     def _step_impl(self, params, kv, token_ids, q_lens, start_pos,
                    page_table, fresh: bool = False):

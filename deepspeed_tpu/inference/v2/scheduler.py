@@ -43,6 +43,7 @@ from ...telemetry import metrics as tm
 from ...telemetry.flight_recorder import get_flight_recorder
 from ...telemetry.state import state as _telemetry
 from ...telemetry.watchdog import get_watchdog
+from ...telemetry.workload_trace import get_workload_trace
 from ...utils.comms_logging import serving_counters
 from .engine import InferenceEngineV2
 from .ragged.blocked_allocator import KVAllocationError, NULL_PAGE
@@ -79,6 +80,13 @@ class Request:
     #: telemetry-gated SLO stamps): the shed valve needs the CURRENT
     #: backlog age even with telemetry off
     submit_mono: float = 0.0
+    #: workload-trace stamps (ISSUE 9, monotonic seconds; 0.0 = unset /
+    #: capture off at the time): first scheduled admission and the
+    #: first/last host-visible token — the trace's queue-wait / TTFT /
+    #: mean-ITL facts, independent of the telemetry-gated SLO stamps
+    first_sched_mono: float = 0.0
+    first_token_mono: float = 0.0
+    last_token_mono: float = 0.0
 
     @property
     def prefill_remaining(self) -> int:
@@ -224,6 +232,11 @@ class FastGenScheduler:
         #: one-way latch: admission stopped (drain-for-snapshot or
         #: shutdown); submit() fails fast with code="closing"
         self._closed = False
+        #: workload observatory (ISSUE 9): the process ledger — its
+        #: ``active`` attribute is the whole disabled-path cost of every
+        #: capture hook below
+        self._wtrace = get_workload_trace()
+        self._bind_backlog_gauges()
         self._snapshot_grace_s = float(
             getattr(sv, "snapshot_grace_s", 5.0) or 0.0)
         self._snapshot_path = str(getattr(sv, "snapshot_path", "") or "")
@@ -232,6 +245,71 @@ class FastGenScheduler:
             # (spot-VM preemption) to drain->snapshot on this scheduler
             maybe_install_drain_handler(self, self._snapshot_path,
                                         self._snapshot_grace_s)
+
+    def _bind_backlog_gauges(self) -> None:
+        """Instantaneous backlog gauges (ISSUE 9 satellite): the SLO
+        histograms only record at drain, so a /metrics scraper can't
+        see a BUILDING backlog — these callback gauges read the live
+        queues at scrape time (weakref: the registry must not keep a
+        discarded scheduler alive; with several schedulers in one
+        process the newest owns the gauges, the ds_kv_* convention)."""
+        import weakref
+        ref = weakref.ref(self)
+
+        def read(attr):
+            def _read(r=ref, a=attr):
+                sched = r()
+                return len(getattr(sched, a)) if sched is not None else 0
+            return _read
+
+        tm.FASTGEN_QUEUE_DEPTH.bind(read("_pending"))
+        tm.FASTGEN_RUNNING.bind(read("_running"))
+        tm.FASTGEN_PREEMPTED.bind(read("_preempted"))
+
+    # -- workload trace (ISSUE 9): capture at drain/error points -------------
+    def _trace_finish(self, req: Request, outcome: str) -> None:
+        """Append one terminated request to the workload ledger:
+        lengths, sampling params, latency facts, and the prompt's
+        chained page-digest chain (the prefix cache's own hash, so the
+        recorded sharing structure is exactly what the cache saw) —
+        never token ids.  Callers gate on ``self._wtrace.active``."""
+        from .ragged.prefix_cache import PrefixCache
+        page = self._engine.model.kv_config.page_size
+        prompt = np.asarray(req.prompt)
+        digests: List[str] = []
+        if outcome not in ("shed", "closing"):
+            # the O(prompt) digest chain is skipped on the admission
+            # fast-reject path — it exists to fail fast under overload,
+            # and shed prompts never touched the engine (replay
+            # synthesizes them as unshared full-length prompts)
+            d = b""
+            for i in range(len(prompt) // page):
+                d = PrefixCache.chain(d, prompt[i * page:(i + 1) * page])
+                digests.append(d.hex())
+        n = len(req.generated)
+        p = req.params
+        self._wtrace.record_request(
+            uid=req.uid, arrival_mono=req.submit_mono,
+            prompt_len=len(prompt), gen_len=n, digests=digests,
+            page_size=page,
+            vocab_size=int(getattr(self._engine.model.cfg,
+                                   "vocab_size", 0)),
+            temperature=p.temperature, top_k=p.top_k, top_p=p.top_p,
+            max_new_tokens=p.max_new_tokens, outcome=outcome,
+            ttft_ms=((req.first_token_mono - req.submit_mono) * 1e3
+                     if req.first_token_mono else None),
+            itl_ms=((req.last_token_mono - req.first_token_mono) * 1e3
+                    / (n - 1)
+                    if n > 1 and req.first_token_mono else None),
+            queue_wait_ms=((req.first_sched_mono - req.submit_mono) * 1e3
+                           if req.first_sched_mono else None))
+
+    def _trace_token(self, req: Request) -> None:
+        """Stamp one host-visible token (capture-on path only)."""
+        mono = time.monotonic()
+        if req.first_token_mono == 0.0:
+            req.first_token_mono = mono
+        req.last_token_mono = mono
 
     # -- request lifecycle ---------------------------------------------------
     def submit(self, uid: int, prompt: Sequence[int],
@@ -350,6 +428,10 @@ class FastGenScheduler:
         get_flight_recorder().record(
             "request.error", uid=req.uid, code=code,
             message=message[:200], tokens=len(req.generated))
+        if self._wtrace.active:
+            # error point of the workload ledger: the outcome code IS
+            # the structured error code
+            self._trace_finish(req, code)
 
     def _expire_requests(self) -> None:
         """Terminate every request whose deadline has passed (pending,
@@ -453,6 +535,8 @@ class FastGenScheduler:
             req.generated.append(tok)
             if _telemetry.enabled:
                 self._note_token_slo(req)
+            if self._wtrace.active:
+                self._trace_token(req)
             out[uid] = tok
             if on_token is not None:
                 on_token(uid, tok)
@@ -464,6 +548,8 @@ class FastGenScheduler:
                     "request.done", uid=uid, tokens=len(req.generated))
                 self._engine.flush(uid)
                 self._running.pop(uid, None)
+                if self._wtrace.active:
+                    self._trace_finish(req, "ok")
         return out
 
     # -- double buffer: chained decode dispatch ------------------------------
@@ -722,6 +808,8 @@ class FastGenScheduler:
                 req.prompt_sent += chunk
                 advances.append((req, chunk))
                 serving_counters.record_prefill(chunk)
+                if self._wtrace.active and req.first_sched_mono == 0.0:
+                    req.first_sched_mono = time.monotonic()
                 if _telemetry.enabled and req.first_sched_s == 0.0:
                     # first scheduled admission: close the queue-wait
                     # window opened at submit
@@ -847,6 +935,8 @@ class FastGenScheduler:
             req.generated.append(tok)
             if _telemetry.enabled:
                 self._note_token_slo(req)
+            if self._wtrace.active:
+                self._trace_token(req)
             out[req.uid] = tok
             if on_token is not None:
                 on_token(req.uid, tok)
@@ -859,6 +949,8 @@ class FastGenScheduler:
                     tokens=len(req.generated))
                 self._engine.flush(req.uid)
                 del self._running[req.uid]
+                if self._wtrace.active:
+                    self._trace_finish(req, "ok")
         return out
 
     # -- graceful degradation (ISSUE 7) --------------------------------------

@@ -239,7 +239,12 @@ class TelemetryConfig(DeepSpeedConfigModel):
     verdicts fire (-1 = keep, default 8); ``postmortem_dir`` where
     crash/anomaly artifacts land ("" = keep, default
     ``DS_POSTMORTEM_DIR``); ``flight_recorder_events`` resizes the
-    structured event ring (0 = keep, default 1024)."""
+    structured event ring (0 = keep, default 1024).
+
+    Workload observatory (ISSUE 9): ``workload_trace_path`` opens the
+    content-free per-request JSONL ledger ("" = keep, same as
+    ``DS_WORKLOAD_TRACE``); ``workload_trace_max_mb`` bounds one
+    rotation generation (0 = keep, default 32)."""
     enabled: Optional[bool] = None
     metrics_port: int = 0
     trace_buffer: int = 0
@@ -248,6 +253,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
     watchdog_warmup: int = -1
     postmortem_dir: str = ""
     flight_recorder_events: int = 0
+    workload_trace_path: str = ""
+    workload_trace_max_mb: int = 0
 
     def apply(self) -> None:
         """Push this block into the process-wide telemetry state (shared
@@ -258,7 +265,9 @@ class TelemetryConfig(DeepSpeedConfigModel):
                        watchdog_threshold=self.watchdog_threshold,
                        watchdog_warmup=self.watchdog_warmup,
                        postmortem_dir=self.postmortem_dir,
-                       flight_recorder_events=self.flight_recorder_events)
+                       flight_recorder_events=self.flight_recorder_events,
+                       workload_trace_path=self.workload_trace_path,
+                       workload_trace_max_mb=self.workload_trace_max_mb)
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

@@ -36,6 +36,9 @@ from .watchdog import Watchdog, get_watchdog  # noqa: F401
 from .flight_recorder import (FlightRecorder,  # noqa: F401
                               dump_postmortem, get_flight_recorder,
                               maybe_install_exit_handlers)
+from .workload_trace import (WorkloadTrace,  # noqa: F401
+                             get_workload_trace,
+                             maybe_configure_from_env)
 
 
 def enabled() -> bool:
@@ -63,7 +66,9 @@ def apply_settings(enabled: "bool | None", metrics_port: int = 0,
                    watchdog_threshold: float = 0.0,
                    watchdog_warmup: int = -1,
                    postmortem_dir: str = "",
-                   flight_recorder_events: int = 0) -> None:
+                   flight_recorder_events: int = 0,
+                   workload_trace_path: str = "",
+                   workload_trace_max_mb: int = 0) -> None:
     """Push a ``telemetry`` config block into the process-wide state —
     the single implementation behind both the runtime config's and the
     inference-v2 config's ``TelemetryConfig.apply()``.  ``enabled=None``
@@ -71,11 +76,15 @@ def apply_settings(enabled: "bool | None", metrics_port: int = 0,
     0 mean off / keep current capacity.  ISSUE 5 knobs follow the same
     keep-current convention: ``watchdog=None``, ``watchdog_threshold=0``,
     ``watchdog_warmup=-1``, ``postmortem_dir=""``,
-    ``flight_recorder_events=0``."""
+    ``flight_recorder_events=0``; so do the ISSUE 9 workload-trace
+    knobs (``workload_trace_path=""``, ``workload_trace_max_mb=0``)."""
     if enabled is not None:
         set_enabled(enabled)
     if trace_buffer:
         get_tracer().resize(trace_buffer)
+    if workload_trace_path or workload_trace_max_mb:
+        get_workload_trace().configure(workload_trace_path,
+                                       max_mb=workload_trace_max_mb)
     get_watchdog().configure(enabled=watchdog,
                              threshold=watchdog_threshold,
                              warmup=watchdog_warmup,
@@ -101,3 +110,5 @@ def apply_settings(enabled: "bool | None", metrics_port: int = 0,
 maybe_start_from_env()
 # honor DS_POSTMORTEM_ON_EXIT the same way (atexit + SIGTERM bundle)
 maybe_install_exit_handlers()
+# honor DS_WORKLOAD_TRACE the same way (workload ledger capture)
+maybe_configure_from_env()

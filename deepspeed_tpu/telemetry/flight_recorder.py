@@ -11,6 +11,11 @@ preemptions / evictions, watchdog verdicts, checkpoint save/load) plus
 - ``events.json``    — the last-K structured events
 - ``env.json``       — process/env capture + the watchdog's health verdict
 
+plus, when workload capture is enabled (ISSUE 9), a sixth artifact:
+
+- ``workload.jsonl`` — the tail of the live workload-trace ledger, so
+  a crash ships the traffic that caused it alongside the forensics.
+
 Invoked automatically when an unhandled exception escapes
 ``train_batch`` or the FastGen step loop (once per process, into the
 configured postmortem dir), on demand, and — with
@@ -156,6 +161,16 @@ class FlightRecorder:
                     if k.startswith(("DS_", "JAX_", "XLA_"))},
             "health": get_watchdog().health(),
         })
+        # sixth artifact (ISSUE 9): the workload-trace tail — only when
+        # capture is enabled, so telemetry-only processes keep the
+        # five-artifact bundle
+        from .workload_trace import get_workload_trace
+        tail = get_workload_trace().tail_text()
+        if tail is not None:
+            path = os.path.join(dir_path, "workload.jsonl")
+            with open(path, "w") as f:
+                f.write(tail)
+            paths["workload.jsonl"] = path
         return paths
 
     # -- automatic invocation paths ------------------------------------------

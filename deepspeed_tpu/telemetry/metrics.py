@@ -154,6 +154,36 @@ FASTGEN_MIGRATED = registry.counter(
     "requests terminated with code=migrated because the preemption "
     "grace budget expired before a snapshot was written")
 
+# -- workload observatory (ISSUE 9) ------------------------------------------
+FASTGEN_TRACE_RECORDS = registry.counter(
+    "ds_fastgen_trace_records_total",
+    "request records appended to the workload-trace ledger")
+FASTGEN_QUEUE_DEPTH = registry.gauge(
+    "ds_fastgen_queue_depth",
+    "requests waiting for first admission on the live scheduler")
+FASTGEN_RUNNING = registry.gauge(
+    "ds_fastgen_running",
+    "requests currently running on the live scheduler")
+FASTGEN_PREEMPTED = registry.gauge(
+    "ds_fastgen_preempted",
+    "requests preempted to host (KV offloaded) on the live scheduler")
+FASTGEN_PROGRAM_FLOPS = registry.gauge(
+    "ds_fastgen_program_flops",
+    "post-fusion XLA FLOPs of the most recently dispatched serving "
+    "program (compiled.cost_analysis per step-cache key)")
+FASTGEN_PROGRAM_BYTES = registry.gauge(
+    "ds_fastgen_program_bytes",
+    "post-fusion bytes accessed of the most recently dispatched "
+    "serving program")
+FASTGEN_MFU = registry.gauge(
+    "ds_fastgen_mfu",
+    "serving model-FLOPs utilization: dispatched program FLOPs / wall "
+    "since the cost window opened / peak (DS_PEAK_FLOPS)")
+FASTGEN_BYTES_PER_S = registry.gauge(
+    "ds_fastgen_bytes_per_s",
+    "serving HBM traffic rate: dispatched program bytes accessed / "
+    "wall since the cost window opened")
+
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(
     "ds_fastgen_ttft_ms", "time to first token, submit -> host-visible")

@@ -300,6 +300,11 @@ class Watchdog:
             return
         tm.FASTGEN_COMPILE_ON_PATH.inc()
         self._record_event("watchdog.compile_on_path", key=repr(key))
+        # workload observatory (ISSUE 9): an on-path compile is exactly
+        # a key the precompiled lattice missed — ship it to the ledger
+        # so tools/analyze_trace.py can recommend a lattice covering it
+        from .workload_trace import get_workload_trace
+        get_workload_trace().record_compile(key)
         now = time.monotonic()
         with self._lock:
             self._compile_times.append(now)

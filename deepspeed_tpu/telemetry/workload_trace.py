@@ -1,0 +1,381 @@
+"""Workload trace (ISSUE 9): what did production traffic actually look
+like — recorded so it can be replayed and analyzed.
+
+A bounded, rotating, append-only JSONL ledger of per-request workload
+FACTS, written by the FastGenScheduler at its drain/error points:
+
+- ``{"kind": "meta", ...}``    — one header per file: schema version,
+  page size, vocab size, wall-clock epoch.
+- ``{"kind": "request", ...}`` — one line per terminated request:
+  arrival-time offset (seconds since the trace opened), prompt length,
+  generated length, sampling params (temperature / top_k / top_p /
+  max_new_tokens), the chained page-digest prefix chain (shareability
+  structure), outcome code ("ok" or the structured RequestError code),
+  and TTFT / mean-ITL / queue-wait milliseconds.
+- ``{"kind": "keys", ...}``    — periodic summary of step-cache key
+  occupancy: how often each compiled ``(S, Q, P, fresh[, kind, ...])``
+  program actually ran (aggregated in memory, flushed every
+  :data:`KEY_FLUSH_EVERY` dispatches — no per-step I/O).
+- ``{"kind": "compile", ...}`` — one line per XLA compile executed ON
+  the request path (the watchdog's recompile accounting feeds it), so
+  the analyzer sees exactly which keys the precompiled lattice missed.
+
+**Content-free by construction**: token IDs never enter the ledger —
+prompts appear only as lengths plus the prefix cache's chained blake2b
+page digests (``prefix_cache.PrefixCache.chain``), which preserve the
+cross-request sharing structure without the content.  A digest chain is
+exactly what ``tools/replay_trace.py`` needs to synthesize anonymized
+prompts with identical length and prefix-sharing structure.
+
+Enabled by a path: ``DS_WORKLOAD_TRACE=/path/trace.jsonl`` (read at
+import, like ``DS_METRICS_PORT``) or ``telemetry.workload_trace_path``
+on either engine config through :func:`..apply_settings`.  The disabled
+path of every entry point is one attribute read (``self.active``) —
+the span/watchdog cost contract.  Rotation: when the file passes
+``max_bytes`` (``workload_trace_max_mb`` / ``DS_WORKLOAD_TRACE_MAX_MB``,
+default 32 MiB) it moves to ``<path>.1`` (one generation kept), so a
+long-lived server is bounded at ~2x max_bytes of disk.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import metrics as tm
+
+TRACE_VERSION = 1
+DEFAULT_MAX_BYTES = 32 << 20
+#: step-key occupancy summary cadence (dispatch count between flushes)
+KEY_FLUSH_EVERY = 2048
+
+
+def _json_key(key) -> list:
+    """A step-cache key tuple as a JSON-stable list (ints/bools/strs)."""
+    return [k if isinstance(k, (int, bool, str)) else repr(k)
+            for k in key]
+
+
+class WorkloadTrace:
+    """Bounded rotating JSONL ledger of serving workload facts."""
+
+    def __init__(self) -> None:
+        #: hot-path gate — a plain attribute read, nothing else
+        self.active = False
+        # RLock: the postmortem SIGTERM handler tails the ledger on the
+        # main thread and may interrupt a frame holding this lock
+        self._lock = threading.RLock()
+        self._path = ""
+        self._max_bytes = DEFAULT_MAX_BYTES
+        self._fh = None
+        self._t0: Optional[float] = None    # monotonic epoch of the trace
+        self._header: Optional[Dict[str, Any]] = None
+        self._header_written = False
+        self._key_counts: Dict[tuple, int] = {}
+        self._key_obs = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def configure(self, path: str = "", max_mb: int = 0,
+                  max_bytes: int = 0) -> None:
+        """Config-block entry point ("" / 0 = keep current).  Setting a
+        new path closes the previous ledger and opens the new one
+        (append mode; the monotonic epoch restarts).  ``max_bytes`` is
+        the sub-MiB test seam behind ``max_mb``."""
+        with self._lock:
+            if max_mb:
+                self._max_bytes = int(max_mb) << 20
+            if max_bytes:
+                self._max_bytes = int(max_bytes)
+            if not path or path == self._path:
+                return
+            self._close_locked()
+            self._path = path
+            try:
+                self._open_locked()
+            except OSError:
+                # a failed open must not latch the path: a later retry
+                # with the same (now-valid) path would hit the
+                # `path == self._path` early-return and silently never
+                # open the ledger
+                self._path = ""
+                raise
+
+    def close(self) -> None:
+        """Flush pending key counts and stop capturing."""
+        with self._lock:
+            self._close_locked()
+            self._path = ""
+
+    @contextlib.contextmanager
+    def suspended(self):
+        """Temporarily stop capturing (the ledger stays open).  A tool
+        that DRIVES a scheduler while studying a ledger — replay, the
+        bench replay leg — must not append its own synthetic traffic
+        to the very trace it is reading."""
+        was = self.active
+        self.active = False
+        try:
+            yield
+        finally:
+            # a close()/configure() inside the block wins: never
+            # re-activate a ledger whose file is gone
+            self.active = was and self._fh is not None
+
+    def _io_error_locked(self, where: str, exc: OSError) -> None:
+        """Ledger I/O is best-effort: a runtime write failure (ENOSPC,
+        vanished directory, failed rotation) deactivates capture with
+        ONE warning instead of raising into the serving step — an
+        observability failure must never take down the request path.
+        The path unlatches too, so a later configure() retry can
+        reopen it."""
+        self.active = False
+        self._path = ""
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+        try:
+            from ..utils.logging import logger
+            logger.warning(
+                "workload trace: %s failed (%s) — capture disabled; "
+                "reconfigure workload_trace_path to retry", where, exc)
+        except Exception:
+            pass
+
+    def _open_locked(self) -> None:
+        d = os.path.dirname(os.path.abspath(self._path))
+        os.makedirs(d, exist_ok=True)
+        self._fh = open(self._path, "a")
+        self._t0 = time.monotonic()
+        self._header_written = False
+        self.active = True
+
+    def _close_locked(self) -> None:
+        self.active = False
+        if self._fh is not None:
+            try:
+                self._flush_keys_locked()
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    # -- record points -------------------------------------------------------
+    def record_request(self, *, uid: int, arrival_mono: float,
+                       prompt_len: int, gen_len: int,
+                       digests: List[str], page_size: int,
+                       vocab_size: int, temperature: float, top_k: int,
+                       top_p: float, max_new_tokens: int, outcome: str,
+                       ttft_ms: Optional[float],
+                       itl_ms: Optional[float],
+                       queue_wait_ms: Optional[float]) -> None:
+        """One terminated request (scheduler drain/error point).  Only
+        lengths, digests, params and latencies — never token ids."""
+        if not self.active:
+            return
+        rec = {
+            "kind": "request",
+            "uid": int(uid),
+            "arrival_s": self._offset(arrival_mono),
+            "prompt_len": int(prompt_len),
+            "gen_len": int(gen_len),
+            "digests": digests,
+            "temperature": round(float(temperature), 6),
+            "top_k": int(top_k),
+            "top_p": round(float(top_p), 6),
+            "max_new_tokens": int(max_new_tokens),
+            "outcome": str(outcome),
+            "ttft_ms": None if ttft_ms is None else round(ttft_ms, 3),
+            "itl_ms": None if itl_ms is None else round(itl_ms, 3),
+            "queue_wait_ms": (None if queue_wait_ms is None
+                              else round(queue_wait_ms, 3)),
+        }
+        with self._lock:
+            if not self.active:
+                return
+            try:
+                if not self._header_written:
+                    self._header = {"kind": "meta",
+                                    "version": TRACE_VERSION,
+                                    "page_size": int(page_size),
+                                    "vocab_size": int(vocab_size),
+                                    "time_unix": round(time.time(), 3)}
+                    self._write_locked(self._header)
+                    self._header_written = True
+                self._write_locked(rec)
+                # requests are rare; a crash ships the tail
+                self._fh.flush()
+            except OSError as e:
+                self._io_error_locked("request write", e)
+                return
+        tm.FASTGEN_TRACE_RECORDS.inc()
+
+    def note_step_key(self, key: tuple) -> None:
+        """One compiled-program dispatch (``model._get_step``) — counted
+        in memory, flushed as a ``keys`` summary record every
+        :data:`KEY_FLUSH_EVERY` dispatches (never per-step I/O)."""
+        if not self.active:
+            return
+        with self._lock:
+            if not self.active:
+                return
+            self._key_counts[key] = self._key_counts.get(key, 0) + 1
+            self._key_obs += 1
+            if self._key_obs >= KEY_FLUSH_EVERY:
+                try:
+                    self._flush_keys_locked()
+                except OSError as e:
+                    self._io_error_locked("keys flush", e)
+
+    def record_compile(self, key) -> None:
+        """One XLA compile ON the serving request path (watchdog
+        recompile accounting) — the keys the precompiled lattice
+        missed, written immediately (compiles are rare and the analyzer
+        needs every one)."""
+        if not self.active:
+            return
+        with self._lock:
+            if not self.active:
+                return
+            try:
+                self._write_locked({"kind": "compile",
+                                    "key": _json_key(key),
+                                    "t_s": self._offset(time.monotonic())})
+                self._fh.flush()
+            except OSError as e:
+                self._io_error_locked("compile write", e)
+
+    def flush(self) -> None:
+        """Flush pending key counts and the OS buffer."""
+        with self._lock:
+            if not self.active:
+                return
+            try:
+                self._flush_keys_locked()
+                self._fh.flush()
+            except OSError as e:
+                self._io_error_locked("flush", e)
+
+    # -- postmortem handoff --------------------------------------------------
+    def tail_text(self, max_bytes: int = 256 << 10) -> Optional[str]:
+        """The last ``max_bytes`` of the live ledger (whole lines), for
+        the flight recorder's ``workload.jsonl`` artifact; None when
+        capture is off.  Reads across the rotation boundary: the
+        pre-read key flush may itself rotate a nearly-full ledger, and
+        a tail of just the fresh file would ship almost nothing exactly
+        when the trace mattered most."""
+        with self._lock:
+            if not self.active:
+                return None
+            try:
+                self._flush_keys_locked()
+                self._fh.flush()
+            except OSError as e:
+                self._io_error_locked("tail flush", e)
+                return None
+            text = self._read_tail(self._path, max_bytes)
+            if text is None:
+                return None
+            if len(text) < max_bytes:
+                prev = self._read_tail(self._path + ".1",
+                                       max_bytes - len(text))
+                if prev:
+                    text = prev + text
+        return text
+
+    @staticmethod
+    def _read_tail(path: str, nbytes: int) -> Optional[str]:
+        """Last ``nbytes`` of ``path`` starting at a whole line; None
+        when unreadable."""
+        try:
+            with open(path) as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - nbytes))
+                text = f.read()
+        except OSError:
+            return None
+        if len(text) < size:  # started mid-line: drop the partial one
+            text = text.split("\n", 1)[-1]
+        return text
+
+    # -- internals -----------------------------------------------------------
+    def _offset(self, mono: float) -> float:
+        return round(max(0.0, mono - (self._t0 or mono)), 6)
+
+    def _flush_keys_locked(self) -> None:
+        if not self._key_counts or self._fh is None:
+            return
+        counts = [[_json_key(k), n]
+                  for k, n in sorted(self._key_counts.items(),
+                                     key=lambda kv: -kv[1])]
+        self._key_counts.clear()
+        self._key_obs = 0
+        self._write_locked({"kind": "keys",
+                            "t_s": self._offset(time.monotonic()),
+                            "counts": counts})
+
+    def _write_locked(self, rec: Dict[str, Any]) -> None:
+        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        if self._fh.tell() >= self._max_bytes:
+            self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Bounded retention: current ledger -> ``<path>.1`` (replacing
+        the previous generation), fresh file re-opens with a new header
+        (the monotonic epoch is PRESERVED so arrival offsets stay on
+        one axis across a rotation).  OSError propagates to the guarded
+        record entry points, which deactivate capture — swallowing it
+        here would reopen the oversized file and re-attempt rotation on
+        every later write, violating the ~2x disk bound."""
+        self._fh.close()
+        os.replace(self._path, self._path + ".1")
+        self._fh = open(self._path, "a")
+        self._header_written = False
+        if self._header is not None:
+            self._write_locked(dict(self._header, rotated=True))
+            self._header_written = True
+
+
+#: process-wide singleton
+_TRACE = WorkloadTrace()
+
+
+def get_workload_trace() -> WorkloadTrace:
+    return _TRACE
+
+
+def maybe_configure_from_env() -> bool:
+    """Honor ``DS_WORKLOAD_TRACE`` (path) and
+    ``DS_WORKLOAD_TRACE_MAX_MB`` as soon as telemetry is imported."""
+    path = os.environ.get("DS_WORKLOAD_TRACE", "")
+    max_mb = 0
+    raw = os.environ.get("DS_WORKLOAD_TRACE_MAX_MB", "")
+    if raw:
+        try:
+            max_mb = int(raw)
+        except ValueError:
+            from ..utils.logging import logger
+            logger.warning(
+                "DS_WORKLOAD_TRACE_MAX_MB=%r is not an int — keeping "
+                "the default rotation bound", raw)
+    if not (path or max_mb):
+        return False
+    try:
+        _TRACE.configure(path, max_mb=max_mb)
+    except OSError as e:
+        # import-time path (telemetry/__init__): an unwritable ledger
+        # path degrades to a warning, never an import error — the
+        # server.py maybe_start_from_env convention
+        from ..utils.logging import logger
+        logger.warning(
+            "DS_WORKLOAD_TRACE=%r: ledger not opened (%s) — "
+            "continuing without workload capture", path, e)
+        return False
+    return bool(path)

@@ -60,6 +60,7 @@ CHAOS_TESTS = frozenset([
 ])
 
 HEAVY_TESTS = frozenset([
+    "tests/test_workload_trace.py::TestCostAccounting::test_precompiled_and_on_path_costs_agree",  # 6.5s, 2 engine builds + small precompile lattice (newly added)
     "tests/test_prefix_cache.py::TestServingParity::test_parity_under_preemption",  # 11.5s, small-pool engine build (newly added)
     "tests/test_prefix_cache.py::TestServingParity::test_parity_sliding_window_model",  # 4.0s, windowed engine build (newly added)
     "tests/test_autotuning.py::test_end_to_end_tune_picks_best",  # 7.01s

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Mine a workload trace for the request-shape facts that choose a
+serving config (ISSUE 9; the direct input ROADMAP item 5 needs).
+
+From a JSONL ledger captured by ``telemetry/workload_trace.py``:
+
+- the request-length distribution (prompt / total tokens, percentiles),
+  outcome mix, and an arrival-overlap concurrency estimate;
+- the **(S, Q, P, fresh[, kind, ...]) occupancy distribution** — how
+  often each compiled program actually ran (the ``keys`` summary
+  records) — plus every XLA compile that executed ON the request path
+  (the ``compile`` records: exactly the keys the precompiled lattice
+  missed);
+- a **coverage report** of the current default power-of-two lattice
+  (``inference.v2.engine.lattice_keys`` — the same enumeration
+  ``precompile()`` compiles, so this report can't drift from the live
+  path) against the observed keys;
+- a **recommended bucket lattice**: quantile-fitted Q/P boundaries
+  (bucket tops placed on the observed length distribution instead of
+  fixed powers, bounded per-bucket overshoot) plus a recommended
+  precompile key set that covers every observed key — by construction
+  its coverage report shows zero uncovered on-path compile keys.
+
+Usage::
+
+    python tools/analyze_trace.py --trace trace.jsonl
+        [--max-concurrency 512] [--batch-size 768] [--ratio 1.3]
+        [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Sequence
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+try:
+    from . import replay_trace
+except ImportError:                      # run as a script: tools/ on path
+    import replay_trace
+
+
+def fit_buckets(lengths: Sequence[int], ratio: float = 1.3,
+                max_buckets: int = 12, floor: int = 1) -> List[int]:
+    """Quantile-style bucket tops fit to an observed length
+    distribution: greedily group sorted distinct lengths so every
+    length maps to a top within ``ratio``x of itself (each bucket's
+    top is the LARGEST observed length it covers — zero overshoot at
+    the top, bounded overshoot at the bottom).  When that needs more
+    than ``max_buckets`` buckets, the ratio widens until it fits.  A
+    bimodal distribution gets tops at the modes, not at the enclosing
+    powers of two."""
+    # a ratio <= 1 can never merge (and the widening step below can't
+    # grow a non-positive one) — floor it instead of hanging
+    ratio = max(float(ratio), 1.001)
+    vals = sorted({max(int(v), floor) for v in lengths})
+    if not vals:
+        return []
+    while True:
+        buckets: List[int] = []
+        i = 0
+        while i < len(vals):
+            lo = vals[i]
+            j = i
+            while j + 1 < len(vals) and vals[j + 1] <= lo * ratio:
+                j += 1
+            buckets.append(vals[j])
+            i = j + 1
+        if len(buckets) <= max_buckets:
+            return buckets
+        ratio *= 1.25
+
+
+#: one percentile implementation across the observatory tools
+_pct = replay_trace.percentile
+
+
+def _concurrency_estimate(requests: List[Dict[str, Any]]) -> int:
+    """Max overlap of [arrival, completion] intervals, completion
+    approximated from the recorded latency facts (TTFT + (n-1) * mean
+    ITL); requests without stamps count as instantaneous."""
+    events = []
+    for r in requests:
+        t0 = float(r.get("arrival_s", 0.0))
+        dur = 0.0
+        if r.get("ttft_ms") is not None:
+            dur += float(r["ttft_ms"]) / 1e3
+        if r.get("itl_ms") is not None and int(r.get("gen_len", 0)) > 1:
+            dur += float(r["itl_ms"]) * (int(r["gen_len"]) - 1) / 1e3
+        events.append((t0, 1))
+        events.append((t0 + dur, -1))
+    peak = cur = 0
+    for _, d in sorted(events):
+        cur += d
+        peak = max(peak, cur)
+    return peak
+
+
+def observed_keys(trace: Dict[str, Any]) -> Dict[tuple, int]:
+    """Occupancy union: step-key summaries plus on-path compiles (a
+    compiled key was dispatched at least once even if the process died
+    before its ``keys`` summary flushed)."""
+    occ = {tuple(k): int(n) for k, n in trace["key_counts"].items()}
+    for k in trace["compiles"]:
+        occ.setdefault(tuple(k), 1)
+    return occ
+
+
+def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
+            batch_size: int = 768, ratio: float = 1.3,
+            max_buckets: int = 12) -> Dict[str, Any]:
+    requests = trace["requests"]
+    meta = trace["meta"]
+    page = int(meta.get("page_size", 16) or 16)
+
+    prompt_lens = [int(r["prompt_len"]) for r in requests]
+    total_lens = [int(r["prompt_len"]) + int(r["gen_len"])
+                  for r in requests]
+    outcomes: Dict[str, int] = {}
+    for r in requests:
+        outcomes[r.get("outcome", "?")] = \
+            outcomes.get(r.get("outcome", "?"), 0) + 1
+    concurrency = _concurrency_estimate(requests)
+
+    occ = observed_keys(trace)
+    compile_keys = [tuple(k) for k in trace["compiles"]]
+
+    # -- current-lattice coverage (the ONE shared enumeration) --------
+    from deepspeed_tpu.inference.v2.engine import lattice_keys
+    mc = max_concurrency or max(concurrency, 1)
+    current = set(lattice_keys(
+        max_prompt=max(prompt_lens), max_new_tokens=max(
+            max(int(r["gen_len"]) for r in requests), 1),
+        max_concurrency=mc, page_size=page,
+        max_ragged_batch_size=batch_size, has_fresh=True,
+        sampling=True))
+    uncovered = sorted(k for k in occ if k not in current)
+
+    # -- recommended lattice ------------------------------------------
+    q_buckets = fit_buckets(prompt_lens, ratio=ratio,
+                            max_buckets=max_buckets)
+    p_buckets = fit_buckets([-(-t // page) for t in total_lens],
+                            ratio=ratio, max_buckets=max_buckets)
+    s_buckets = sorted({int(k[0]) for k in occ}) or [mc]
+    # the recommended precompile set: every key traffic actually formed
+    # — which the fitted boundaries above would re-generate once
+    # build_batch learns non-power lattices (ROADMAP item 5).  The
+    # coverage field below checks it against the ON-PATH COMPILE keys
+    # specifically (the acceptance bar); today's recommendation covers
+    # them because compiles ⊆ occupancy, but the check is against the
+    # emitted key set, so a future recommendation that trims keys
+    # (e.g. dropping a rare-key tail) surfaces any regression here
+    recommended_keys = sorted(occ)
+    rec_uncovered = sorted(set(compile_keys) - set(recommended_keys))
+
+    return {
+        "meta": {k: v for k, v in meta.items() if k != "kind"},
+        "requests": {
+            "count": len(requests),
+            "outcomes": outcomes,
+            "prompt_len": {"p50": _pct(prompt_lens, 50),
+                           "p90": _pct(prompt_lens, 90),
+                           "max": max(prompt_lens)},
+            "total_len": {"p50": _pct(total_lens, 50),
+                          "p90": _pct(total_lens, 90),
+                          "max": max(total_lens)},
+            "concurrency_estimate": concurrency,
+            "ttft_p50_ms": _pct([r["ttft_ms"] for r in requests
+                                 if r.get("ttft_ms") is not None], 50),
+            "queue_wait_p50_ms": _pct(
+                [r["queue_wait_ms"] for r in requests
+                 if r.get("queue_wait_ms") is not None], 50),
+        },
+        "occupancy": {
+            "keys": [[list(k), n]
+                     for k, n in sorted(occ.items(),
+                                        key=lambda kv: -kv[1])],
+            "distinct_keys": len(occ),
+            "dispatches": sum(occ.values()),
+            "compile_on_path_keys": [list(k) for k in compile_keys],
+        },
+        "coverage": {
+            "current_lattice_size": len(current),
+            "observed_keys": len(occ),
+            "uncovered_by_current": [list(k) for k in uncovered],
+        },
+        "recommended_lattice": {
+            "page_size": page,
+            "s_buckets": s_buckets,
+            "q_buckets": q_buckets,
+            "p_buckets": p_buckets,
+            "keys": [list(k) for k in recommended_keys],
+            "uncovered_on_path_compile_keys": [list(k)
+                                               for k in rec_uncovered],
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", required=True, help="workload JSONL path")
+    ap.add_argument("--max-concurrency", type=int, default=0,
+                    help="current-lattice S range (default: the "
+                    "trace's concurrency estimate)")
+    ap.add_argument("--batch-size", type=int, default=768,
+                    help="max_ragged_batch_size of the serving config")
+    ap.add_argument("--ratio", type=float, default=1.3,
+                    help="max per-bucket overshoot of the fitted "
+                    "boundaries")
+    ap.add_argument("--max-buckets", type=int, default=12)
+    ap.add_argument("--json", default="",
+                    help="also write the report to this path")
+    args = ap.parse_args(argv)
+
+    trace = replay_trace.load_trace(args.trace)
+    report = analyze(trace, max_concurrency=args.max_concurrency,
+                     batch_size=args.batch_size, ratio=args.ratio,
+                     max_buckets=args.max_buckets)
+    print(json.dumps(report, indent=1, default=str))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,16 +1,23 @@
 #!/usr/bin/env python
-"""Metric-namespace lint (ISSUE 4 CI satellite).
+"""Metric-namespace lint (ISSUE 4 CI satellite; ISSUE 9 dead-metric
+pass).
 
 Asserts that every metric registered in the telemetry registry
 
 - matches the ``ds_<area>_<name>`` naming convention with a known area
-  (counters additionally end in ``_total``), and
-- is documented in docs/DESIGN.md's "Telemetry" metric table,
+  (counters additionally end in ``_total``),
+- is documented in docs/DESIGN.md's "Telemetry" metric table, and
+- is actually RECORDED somewhere in the production tree (a
+  ``.inc(`` / ``.observe(`` / ``.set(`` / ``.bind(`` on the minted
+  object outside ``telemetry/metrics.py``) — a metric minted but never
+  fed is a dead series that scrapes as a forever-zero and rots the
+  dashboard,
 
 so the namespace cannot silently drift: adding a metric without
-documenting it (or with an off-convention name) fails tier-1
-(tests/test_telemetry.py runs :func:`check`) and this script
-(``python tools/check_metrics.py``) exits non-zero.
+documenting it (or with an off-convention name, or without wiring a
+recording site) fails tier-1 (tests/test_telemetry.py runs
+:func:`check`) and this script (``python tools/check_metrics.py``)
+exits non-zero.
 """
 
 from __future__ import annotations
@@ -18,13 +25,59 @@ from __future__ import annotations
 import os
 import re
 import sys
-from typing import List
+from typing import Dict, List
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 AREAS = ("serving", "comm", "kv", "train", "fastgen", "chaos")
 NAME_RE = re.compile(
     r"^ds_(%s)_[a-z][a-z0-9_]*$" % "|".join(AREAS))
+
+#: where metric objects are minted — excluded from the recording scan
+CATALOG = os.path.join("deepspeed_tpu", "telemetry", "metrics.py")
+#: the production tree the recording scan walks (tests are deliberately
+#: excluded: a metric recorded only by its test is still dead)
+SCAN_ROOTS = ("deepspeed_tpu", "tools", "bench.py")
+#: a minted identifier counts as recorded when one of these is called
+#: on it anywhere in the scanned tree
+RECORD_METHODS = ("inc", "observe", "set", "bind")
+
+
+def _minted_identifiers() -> Dict[str, str]:
+    """{metric name: python identifier} parsed from the catalog."""
+    path = os.path.join(REPO_ROOT, CATALOG)
+    with open(path) as f:
+        src = f.read()
+    out: Dict[str, str] = {}
+    for m in re.finditer(
+            r"^(?P<ident>[A-Z][A-Z0-9_]*) = registry\.\w+\(\s*\n?\s*"
+            r"\"(?P<name>ds_[a-z0-9_]+)\"", src, re.MULTILINE):
+        out[m.group("name")] = m.group("ident")
+    return out
+
+
+def _scan_recordings() -> str:
+    """Concatenated source of every production .py file outside the
+    catalog (one pass; the per-metric check is a regex over it)."""
+    chunks: List[str] = []
+    for root in SCAN_ROOTS:
+        full = os.path.join(REPO_ROOT, root)
+        if os.path.isfile(full):
+            with open(full) as f:
+                chunks.append(f.read())
+            continue
+        for dirpath, _dirs, files in os.walk(full):
+            if "__pycache__" in dirpath:
+                continue
+            for name in files:
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                if path.endswith(CATALOG):
+                    continue
+                with open(path) as f:
+                    chunks.append(f.read())
+    return "\n".join(chunks)
 
 
 def check(design_path: str = None) -> List[str]:
@@ -43,6 +96,8 @@ def check(design_path: str = None) -> List[str]:
     registered = get_registry().all_metrics()
     if not registered:
         errors.append("no metrics registered — catalog import broken?")
+    idents = _minted_identifiers()
+    source = _scan_recordings()
     for name, metric in sorted(registered.items()):
         if not NAME_RE.match(name):
             errors.append(
@@ -56,6 +111,20 @@ def check(design_path: str = None) -> List[str]:
                 "(add a row to the Telemetry metric table)")
         if not metric.help:
             errors.append(f"{name}: registered without help text")
+        # dead-metric pass (ISSUE 9): minted in the catalog but never
+        # fed anywhere in the production tree.  Metrics registered
+        # OUTSIDE the catalog (tests minting throwaways) are skipped —
+        # the naming/docs lints above already police them.
+        ident = idents.get(name)
+        if ident is not None and not re.search(
+                r"\b%s\s*\.\s*(%s)\s*\(" % (ident,
+                                            "|".join(RECORD_METHODS)),
+                source):
+            errors.append(
+                f"{name}: dead metric — minted as {ident} in "
+                f"{CATALOG} but never recorded "
+                f"(.{'/.'.join(RECORD_METHODS)}) anywhere in "
+                f"{SCAN_ROOTS}")
     return errors
 
 

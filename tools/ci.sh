@@ -6,9 +6,14 @@
 #   2. chaos tier    — every fault-injection test alone (-m chaos), so
 #                      a chaos regression is named even when tier-1's
 #                      summary is long
-#   3. metric lint   — tools/check_metrics.py (naming convention +
-#                      DESIGN.md documentation for every ds_* metric)
-#   4. bench gate    — tools/check_bench.py --strict (latest vs
+#   3. replay smoke  — tools/replay_trace.py --check over the first 32
+#                      requests of the checked-in sample trace: a
+#                      captured workload must replay with matching
+#                      request count / lengths / share structure
+#   4. metric lint   — tools/check_metrics.py (naming convention +
+#                      DESIGN.md documentation + no dead metrics for
+#                      every ds_* metric)
+#   5. bench gate    — tools/check_bench.py --strict (latest vs
 #                      previous BENCH_r*.json; throughput -10% /
 #                      latency +15% tolerances, cross-backend rounds
 #                      downgraded to notes)
@@ -30,6 +35,10 @@ timeout -k 10 "$TIMEOUT" python -m pytest tests/ -q -m 'not slow' \
 
 echo "== chaos tier =="
 python -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+echo "== workload replay smoke =="
+python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
+    --limit 32 --check > /dev/null
 
 echo "== metric namespace lint =="
 python tools/check_metrics.py

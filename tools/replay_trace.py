@@ -1,0 +1,451 @@
+#!/usr/bin/env python
+"""Replay a workload trace against a live FastGenScheduler (ISSUE 9).
+
+Loads a JSONL ledger captured by ``telemetry/workload_trace.py``,
+synthesizes **anonymized** token-id prompts that reproduce each
+request's recorded length and prefix-sharing structure (a prompt page's
+tokens are derived deterministically from its recorded chained digest,
+so two requests share a synthesized page exactly when they shared a
+page at capture time — the content is new, the structure is identical),
+re-issues the requests with original or time-scaled arrival pacing, and
+diffs the resulting SLO percentiles and recompile counters against the
+recorded run.
+
+This is the harness behind ROADMAP item 5's success metric
+(``ds_fastgen_compile_on_path_total == 0`` over a replayed production
+trace): capture production traffic, replay it against a candidate
+config/lattice, and read the counters.
+
+Usage::
+
+    python tools/replay_trace.py --trace trace.jsonl [--speed 2.0]
+        [--limit N] [--tolerance 4] [--check] [--json out.json]
+
+``--speed 0`` (default) replays as fast as the scheduler drains (no
+arrival pacing); ``--speed 1`` paces at recorded arrival offsets,
+``--speed 2`` twice as fast, etc.  ``--check`` exits non-zero when
+structural parity (request count / lengths / share structure / arrival
+order) fails — the CI smoke mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def percentile(vals, q: float):
+    """Nearest-rank percentile over values (None entries dropped);
+    None when empty.  The one implementation the replay report, the
+    recorded-side diff, and tools/analyze_trace.py all share — a
+    rounding change can't silently skew the recorded-vs-replayed
+    ratio from one side only."""
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    k = min(len(vals) - 1, int(round(q / 100.0 * (len(vals) - 1))))
+    return round(float(vals[k]), 3)
+
+
+# -- trace loading -----------------------------------------------------------
+def load_trace(path: str) -> Dict[str, Any]:
+    """Parse a workload-trace JSONL ledger into
+    ``{"meta", "requests", "compiles", "key_counts"}``.  Records of the
+    rotated generation (``<path>.1``) are NOT read — the caller decides
+    whether to concatenate generations."""
+    meta: Dict[str, Any] = {}
+    requests: List[Dict[str, Any]] = []
+    compiles: List[list] = []
+    key_counts: Dict[tuple, int] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            kind = rec.get("kind")
+            if kind == "meta" and not meta:
+                meta = rec
+            elif kind == "request":
+                requests.append(rec)
+            elif kind == "compile":
+                compiles.append(rec["key"])
+            elif kind == "keys":
+                for key, n in rec["counts"]:
+                    key_counts[tuple(key)] = (
+                        key_counts.get(tuple(key), 0) + int(n))
+    if not requests:
+        raise ValueError(f"{path}: no request records")
+    return {"meta": meta, "requests": requests, "compiles": compiles,
+            "key_counts": key_counts}
+
+
+# -- anonymized prompt synthesis ---------------------------------------------
+def synthesize_prompts(requests: List[Dict[str, Any]], page_size: int,
+                       vocab_size: int, seed: int = 0
+                       ) -> List[np.ndarray]:
+    """One int32 prompt per request (by record order), reproducing the
+    recorded lengths and the prefix-sharing structure: a full page's
+    tokens are a pure function of its recorded cumulative digest (equal
+    digests — i.e. equal cumulative prefixes at capture — yield equal
+    synthesized pages; distinct digests yield distinct pages w.h.p.),
+    and the trailing partial page is unique per request (partial pages
+    are never shared by the prefix cache's copy-on-write rule, so
+    uniqueness there cannot change the structure)."""
+    blocks: Dict[str, np.ndarray] = {}
+    prompts: List[np.ndarray] = []
+    for idx, rec in enumerate(requests):
+        parts: List[np.ndarray] = []
+        for digest in rec["digests"]:
+            blk = blocks.get(digest)
+            if blk is None:
+                rng = np.random.default_rng(
+                    (int(digest[:15], 16) << 17) ^ (seed & 0x1FFFF))
+                blk = rng.integers(0, vocab_size, page_size,
+                                   dtype=np.int64).astype(np.int32)
+                blocks[digest] = blk
+            parts.append(blk)
+        rem = int(rec["prompt_len"]) - len(parts) * page_size
+        if rem > 0:
+            rng = np.random.default_rng(
+                (seed << 24) ^ (idx * 2654435761 & 0x7FFFFFFF) ^ 0x5A5A)
+            parts.append(rng.integers(0, vocab_size, rem,
+                                      dtype=np.int64).astype(np.int32))
+        prompts.append(np.concatenate(parts) if parts
+                       else np.zeros(0, np.int32))
+    return prompts
+
+
+def share_signature_recorded(requests: List[Dict[str, Any]]
+                             ) -> List[tuple]:
+    """Canonical sharing structure of the RECORDED prompts: digests
+    renamed to first-occurrence ordinals, one tuple per request."""
+    ids: Dict[str, int] = {}
+    return [tuple(ids.setdefault(d, len(ids)) for d in r["digests"])
+            for r in requests]
+
+
+def share_signature_prompts(prompts: List[np.ndarray], page_size: int
+                            ) -> List[tuple]:
+    """The same canonical structure recomputed from actual token-id
+    prompts via the prefix cache's own chained hash."""
+    from deepspeed_tpu.inference.v2.ragged.prefix_cache import PrefixCache
+    ids: Dict[bytes, int] = {}
+    sigs = []
+    for p in prompts:
+        d = b""
+        sig = []
+        for i in range(len(p) // page_size):
+            d = PrefixCache.chain(d, p[i * page_size:(i + 1) * page_size])
+            sig.append(ids.setdefault(d, len(ids)))
+        sigs.append(tuple(sig))
+    return sigs
+
+
+# -- engine construction -----------------------------------------------------
+def build_replay_engine(meta: Dict[str, Any],
+                        requests: List[Dict[str, Any]],
+                        model_size: str = "debug",
+                        num_pages: int = 0,
+                        max_seqs: int = 32):
+    """A small engine whose geometry (page size, context, KV pool) fits
+    the trace.  The replay measures SCHEDULING/shape behavior — lattice
+    coverage, share structure, relative SLOs — so the weights are
+    random-init and the model family is the debug config unless a
+    larger one is requested."""
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta as flax_meta
+    from deepspeed_tpu.inference.v2 import (
+        InferenceEngineV2, KVCacheConfig, RaggedInferenceEngineConfig,
+        RaggedInferenceModel, StateManagerConfig)
+    from deepspeed_tpu.models.llama import LlamaForCausalLM
+
+    page = int(meta.get("page_size", 16))
+    need = max(int(r["prompt_len"]) + max(1, int(r["gen_len"]))
+               for r in requests) + page
+    max_seq = 1
+    while max_seq < need:
+        max_seq *= 2
+    model_def = LlamaForCausalLM(model_size, max_seq_len=max(max_seq, 64),
+                                 dtype=jnp.float32)
+    cfg = model_def.cfg
+    params = flax_meta.unbox(model_def.init_params(jax.random.key(0)))
+    if not num_pages:
+        # pool sized for max_seqs concurrent worst-case sequences
+        per_seq = -(-need // page)
+        num_pages = max(256, max_seqs * per_seq)
+    kv_cfg = KVCacheConfig(num_layers=cfg.num_layers,
+                           kv_heads=cfg.kv_heads,
+                           head_dim=cfg.dims_per_head, page_size=page,
+                           num_pages=num_pages, dtype=jnp.float32)
+    model = RaggedInferenceModel(cfg, params, kv_config=kv_cfg)
+    return InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        state_manager=StateManagerConfig(
+            max_tracked_sequences=max_seqs,
+            max_ragged_sequence_count=max_seqs,
+            max_ragged_batch_size=max(256, 4 * page))))
+
+
+# -- the replay loop ---------------------------------------------------------
+def replay(engine, requests: List[Dict[str, Any]],
+           prompts: List[np.ndarray], speed: float = 0.0,
+           token_budget: Optional[int] = None,
+           serving=None) -> Dict[str, Any]:
+    """Re-issue the trace against a fresh FastGenScheduler on
+    ``engine``.  ``speed=0`` submits everything up front (as fast as
+    the scheduler drains); ``speed>0`` paces submissions at the
+    recorded arrival offsets divided by ``speed``.  Request ``i``
+    replays with ``max_new_tokens = gen_len_i`` (and no stop token), so
+    generated lengths reproduce exactly regardless of sampled values.
+    Returns the replayed facts: per-request gen lengths, TTFT/queue
+    percentiles, decode tok/s, and the measured-window recompile
+    counters."""
+    from deepspeed_tpu.inference.v2 import FastGenScheduler, SamplingParams
+    from deepspeed_tpu.telemetry import metrics as tm
+    from deepspeed_tpu.telemetry.workload_trace import get_workload_trace
+
+    # a live ledger (DS_WORKLOAD_TRACE still exported on the capture
+    # machine) must not record the replay's own synthetic traffic into
+    # the trace being studied — capture is suspended for the drive
+    with get_workload_trace().suspended():
+        return _replay_impl(FastGenScheduler, SamplingParams, tm,
+                            engine, requests, prompts, speed,
+                            token_budget, serving)
+
+
+def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
+                 prompts, speed, token_budget, serving) -> Dict[str, Any]:
+    order = sorted(range(len(requests)),
+                   key=lambda i: float(requests[i].get("arrival_s", 0.0)))
+    params = [SamplingParams(
+        temperature=float(r.get("temperature", 0.0)),
+        top_k=int(r.get("top_k", 0)), top_p=float(r.get("top_p", 1.0)),
+        max_new_tokens=max(1, int(r["gen_len"]))) for r in requests]
+
+    sched = FastGenScheduler(engine, token_budget=token_budget,
+                             serving=serving)
+    miss0 = tm.FASTGEN_STEP_CACHE_MISS.value
+    comp0 = tm.FASTGEN_COMPILE_ON_PATH.value
+
+    submit_t: Dict[int, float] = {}
+    first_t: Dict[int, float] = {}
+    gen: Dict[int, int] = {}
+    submitted: List[int] = []
+    done_tokens = 0
+    nxt = 0
+    stalls = 0
+    t0 = time.perf_counter()
+    while nxt < len(order) or sched.has_work:
+        now = time.perf_counter()
+        elapsed = (now - t0) * (speed if speed > 0 else 1.0)
+        while nxt < len(order) and (
+                speed <= 0
+                or float(requests[order[nxt]].get("arrival_s", 0.0))
+                <= elapsed):
+            i = order[nxt]
+            verdict = sched.submit(i, prompts[i], params[i])
+            if verdict is None:
+                submit_t[i] = time.perf_counter()
+                submitted.append(i)
+            nxt += 1
+        if sched.has_work:
+            out = sched.step()
+            now = time.perf_counter()
+            stalls = (stalls + 1 if sched.last_step_scheduled == 0
+                      and not out else 0)
+            if stalls > 64:
+                raise RuntimeError(
+                    "replay stalled: requests unschedulable (trace "
+                    "needs a larger KV pool / context than the replay "
+                    "engine has)")
+            for uid, _tok in out.items():
+                done_tokens += 1
+                gen[uid] = gen.get(uid, 0) + 1
+                first_t.setdefault(uid, now)
+        elif nxt < len(order):
+            if speed > 0:
+                gap = (float(requests[order[nxt]].get("arrival_s", 0.0))
+                       - elapsed) / speed
+                time.sleep(min(max(gap, 0.0), 0.01))
+    total = time.perf_counter() - t0
+
+    ttfts = [(first_t[i] - submit_t[i]) * 1e3
+             for i in submitted if i in first_t]
+    return {
+        "requests_submitted": len(submitted),
+        "submit_order": submitted,
+        "gen_lens": {i: gen.get(i, 0) for i in submitted},
+        "errors": {int(u): e.code for u, e in sched.errors.items()},
+        "wall_s": round(total, 4),
+        "decode_tok_s": round(done_tokens / total, 1) if total else None,
+        "ttft_p50_ms": percentile(ttfts, 50),
+        "ttft_p99_ms": percentile(ttfts, 99),
+        "step_cache_miss": tm.FASTGEN_STEP_CACHE_MISS.value - miss0,
+        "compile_on_path": tm.FASTGEN_COMPILE_ON_PATH.value - comp0,
+    }
+
+
+# -- recorded-vs-replayed diff -----------------------------------------------
+def recorded_percentiles(requests: List[Dict[str, Any]]
+                         ) -> Dict[str, Optional[float]]:
+    ttfts = [r.get("ttft_ms") for r in requests]
+    waits = [r.get("queue_wait_ms") for r in requests]
+    return {"ttft_p50_ms": percentile(ttfts, 50),
+            "ttft_p99_ms": percentile(ttfts, 99),
+            "queue_wait_p50_ms": percentile(waits, 50)}
+
+
+def diff_replay(requests: List[Dict[str, Any]],
+                prompts: List[np.ndarray], page_size: int,
+                report: Dict[str, Any],
+                tolerance: float = 4.0) -> Dict[str, Any]:
+    """Structural-parity + SLO diff of one replay against its trace.
+    Structure must match EXACTLY (count, prompt/gen lengths, share
+    structure, arrival order); latency percentiles must agree within a
+    multiplicative ``tolerance`` (host/noise dependent — a replay on
+    the capture machine lands near 1x)."""
+    problems: List[str] = []
+    n = len(requests)
+    if report["requests_submitted"] != n:
+        problems.append(
+            f"request count: {report['requests_submitted']} replayed "
+            f"vs {n} recorded")
+    for i, rec in enumerate(requests):
+        if len(prompts[i]) != int(rec["prompt_len"]):
+            problems.append(
+                f"req {i}: prompt_len {len(prompts[i])} vs recorded "
+                f"{rec['prompt_len']}")
+        want = max(1, int(rec["gen_len"]))
+        got = report["gen_lens"].get(i)
+        if got != want:
+            problems.append(
+                f"req {i}: gen_len {got} vs recorded {want}")
+    if (share_signature_prompts(prompts, page_size)
+            != share_signature_recorded(requests)):
+        problems.append("share structure: synthesized prompts do not "
+                        "reproduce the recorded digest classes")
+    arrival_order = sorted(
+        range(n), key=lambda i: float(requests[i].get("arrival_s", 0.0)))
+    if report["submit_order"] != arrival_order:
+        problems.append("arrival order: replay submitted out of "
+                        "recorded order")
+
+    rec_pct = recorded_percentiles(requests)
+    slo = {}
+    for key in ("ttft_p50_ms", "ttft_p99_ms"):
+        a, b = rec_pct.get(key), report.get(key)
+        ratio = (round(b / a, 3) if a and b else None)
+        slo[key] = {"recorded": a, "replayed": b, "ratio": ratio}
+    within = all(
+        v["ratio"] is None or 1.0 / tolerance <= v["ratio"] <= tolerance
+        for v in slo.values())
+    return {"structural_ok": not problems, "problems": problems,
+            "slo": slo, "slo_within_tolerance": within,
+            "tolerance": tolerance,
+            "compile_on_path": report["compile_on_path"],
+            "recorded_queue_wait_p50_ms": rec_pct["queue_wait_p50_ms"]}
+
+
+def run_replay(trace_path: str, limit: int = 0,
+               include_errors: bool = False, speed: float = 0.0,
+               model_size: str = "debug", seed: int = 0,
+               warmup: bool = True,
+               tolerance: float = 4.0) -> Dict[str, Any]:
+    """The one load → filter → build → synthesize → (shape-warmup) →
+    measured-replay → diff sequence, shared by the CLI, the CI smoke,
+    and bench.py's BENCH_REPLAY leg — so the three can't drift on the
+    warmup convention or the vocab clamp."""
+    trace = load_trace(trace_path)
+    requests = trace["requests"]
+    if not include_errors:
+        requests = [r for r in requests if r.get("outcome") == "ok"]
+    if limit:
+        requests = requests[:limit]
+    if not requests:
+        raise ValueError(f"{trace_path}: no replayable requests")
+    meta = trace["meta"]
+    page = int(meta.get("page_size", 16))
+    engine = build_replay_engine(meta, requests, model_size=model_size)
+    vocab = min(int(meta.get("vocab_size", 0))
+                or engine.model.cfg.vocab_size,
+                engine.model.cfg.vocab_size)
+    prompts = synthesize_prompts(requests, page, vocab, seed=seed)
+    if warmup:
+        # untimed shape warmup (the bench convention): the measured
+        # replay then shows REAL on-path recompiles, not cold-start
+        replay(engine, requests, prompts, speed=0.0)
+        for uid in list(engine.state_manager._seqs):
+            engine.flush(uid)
+        engine.reset_prefix_cache()
+    report = replay(engine, requests, prompts, speed=speed)
+    verdict = diff_replay(requests, prompts, page, report,
+                          tolerance=tolerance)
+    return {"trace": trace_path, "meta": meta,
+            "requests": len(requests),
+            "recorded_compiles": len(trace["compiles"]),
+            "replay": report, "diff": verdict}
+
+
+# -- CLI ---------------------------------------------------------------------
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", required=True, help="workload JSONL path")
+    ap.add_argument("--speed", type=float, default=0.0,
+                    help="arrival pacing: 0 = full speed (default), "
+                    "1 = recorded offsets, 2 = twice as fast, ...")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="replay only the first N requests (0 = all)")
+    ap.add_argument("--model-size", default="debug",
+                    help="llama preset for the replay engine")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="prompt-synthesis seed")
+    ap.add_argument("--tolerance", type=float, default=4.0,
+                    help="SLO percentile agreement factor")
+    ap.add_argument("--include-errors", action="store_true",
+                    help="also replay requests whose recorded outcome "
+                    "was a structured error (default: ok only)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the untimed shape-warmup pass (the "
+                    "measured run then eats the XLA compiles)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero unless structural parity holds "
+                    "(CI smoke mode)")
+    ap.add_argument("--json", default="",
+                    help="also write the full report to this path")
+    args = ap.parse_args(argv)
+
+    try:
+        out = run_replay(args.trace, limit=args.limit,
+                         include_errors=args.include_errors,
+                         speed=args.speed, model_size=args.model_size,
+                         seed=args.seed, warmup=not args.no_warmup,
+                         tolerance=args.tolerance)
+    except ValueError as e:
+        print(f"replay_trace: {e}", file=sys.stderr)
+        return 1
+    verdict = out["diff"]
+    print(json.dumps(out, indent=1, default=str))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1, default=str)
+    if args.check and not verdict["structural_ok"]:
+        print("replay_trace: STRUCTURAL PARITY FAILED", file=sys.stderr)
+        for p in verdict["problems"]:
+            print(f"replay_trace:   {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
