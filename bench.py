@@ -186,30 +186,37 @@ def bench_fastgen(jax):
             sched = FastGenScheduler(engine or eng, serving=serving)
             submit_t = {}
             first_t = {}
+            count = [0]
+
+            # token accounting rides the on_token callback: a
+            # speculative step (BENCH_SPEC) commits a whole accepted
+            # block per row per step, so counting step() return dict
+            # entries (one per uid) would undercount
+            def on_tok(uid, _tok):
+                count[0] += 1
+                if uid not in first_t:
+                    first_t[uid] = time.perf_counter()
+
             t0 = time.perf_counter()
             for i in reqs:
                 sched.submit(i, (prompt_set or prompts)[i], sp_ or sp)
                 submit_t[i] = t0
-            done_tokens = 0
             stalls = 0
             while sched.has_work:
-                out = sched.step()
-                now = time.perf_counter()
+                before = count[0]
+                sched.step(on_token=on_tok)
                 # prefill-only steps return no tokens but ARE progress;
-                # a true stall scheduled zero tokens (scheduler.py uses
-                # the same predicate in run_to_completion)
-                stalls = stalls + 1 if sched.last_step_scheduled == 0 else 0
+                # a true stall scheduled zero tokens AND delivered none
+                # (run_to_completion's predicate, token-count form)
+                stalls = (stalls + 1 if sched.last_step_scheduled == 0
+                          and count[0] == before else 0)
                 if stalls > 32:
                     raise RuntimeError(
                         "scheduler stalled (requests unschedulable — "
                         "prompt exceeds KV capacity?)")
-                for uid in out:
-                    done_tokens += 1
-                    if uid not in first_t:
-                        first_t[uid] = now
             total = time.perf_counter() - t0
             ttfts = [first_t[i] - submit_t[i] for i in reqs if i in first_t]
-            return total, ttfts, done_tokens
+            return total, ttfts, count[0]
 
         # compile OUTSIDE the timed window, reported separately
         t_pre = time.perf_counter()
@@ -397,6 +404,95 @@ def bench_fastgen(jax):
             except Exception as e:  # noqa: BLE001
                 sys.stderr.write(f"bench: fastgen SLO leg failed: {e}\n")
                 result["fastgen_slo_error"] = str(e)[:300]
+        if os.environ.get("BENCH_SPEC", "0") != "0":
+            # speculative-decoding leg (ISSUE 10): the same scheduler
+            # drives a dedicated long-decode engine twice per workload —
+            # speculation off, then on — on a HIGH-repetition workload
+            # (long greedy decode: the model's own repetition loops are
+            # exactly what the prompt-lookup drafter predicts) and a
+            # LOW-repetition one (short decode: loops never develop, the
+            # drafter backs off).  Shape warmup is untimed; the measured
+            # windows report tok/s, accept rate, programs/token and
+            # on-path recompiles.  Own try like the other legs.
+            try:
+                from deepspeed_tpu.inference.v2 import (
+                    KVCacheConfig as _KVC)
+                from deepspeed_tpu.telemetry import metrics as tmet
+                page = 16
+                smodel = LlamaForCausalLM(model_size, max_seq_len=256)
+                scfg = smodel.cfg
+                s_kv = _KVC(num_layers=scfg.num_layers,
+                            kv_heads=scfg.kv_heads,
+                            head_dim=scfg.dims_per_head, page_size=page,
+                            num_pages=512)
+                seng = InferenceEngineV2(RaggedInferenceModel(
+                    scfg, meta.unbox(smodel.init_params(jax.random.key(0))),
+                    kv_config=s_kv))
+                spec_on = ServingOptimizationConfig(
+                    prefix_caching=False, speculative=True)
+                spec_off = ServingOptimizationConfig(prefix_caching=False)
+                n_spec = min(n_req, 8)
+                # HIGH-repetition: constant-token prompts + long greedy
+                # decode — the model falls into its own repetition loop
+                # almost immediately and the prompt-lookup drafter's
+                # cyclic extrapolation predicts it (the bench analogue
+                # of extraction/quote-heavy production traffic).
+                # LOW-repetition: random prompts, short decode — loops
+                # never develop, the drafter backs off.
+                hi_prompts = [[7 % scfg.vocab_size] * 16
+                              for _ in range(n_spec)]
+                lo_prompts = [rng.integers(0, scfg.vocab_size,
+                                           size=16).tolist()
+                              for _ in range(n_spec)]
+                sp_hi = SamplingParams(max_new_tokens=96, temperature=0.0)
+                sp_lo = SamplingParams(max_new_tokens=8, temperature=0.0)
+
+                def spec_leg(prompt_set, sp_leg):
+                    # untimed shape warmup for BOTH serving variants
+                    run(range(n_spec), serving=spec_off,
+                        prompt_set=prompt_set, engine=seng, sp_=sp_leg)
+                    run(range(n_spec), serving=spec_on,
+                        prompt_set=prompt_set, engine=seng, sp_=sp_leg)
+                    t_off, _, d_off = run(range(n_spec), serving=spec_off,
+                                          prompt_set=prompt_set,
+                                          engine=seng, sp_=sp_leg)
+                    serving_counters.reset()
+                    dr0 = tmet.FASTGEN_SPEC_DRAFTED.value
+                    ac0 = tmet.FASTGEN_SPEC_ACCEPTED.value
+                    co0 = tmet.FASTGEN_COMPILE_ON_PATH.value
+                    t_on, _, d_on = run(range(n_spec), serving=spec_on,
+                                        prompt_set=prompt_set,
+                                        engine=seng, sp_=sp_leg)
+                    drafted = tmet.FASTGEN_SPEC_DRAFTED.value - dr0
+                    accepted = tmet.FASTGEN_SPEC_ACCEPTED.value - ac0
+                    return {
+                        "off_tok_s": round(d_off / t_off, 1),
+                        "on_tok_s": round(d_on / t_on, 1),
+                        "accept_rate": (round(accepted / drafted, 4)
+                                        if drafted else 0.0),
+                        "programs_per_token": round(
+                            serving_counters.programs / max(d_on, 1), 4),
+                        "compile_on_path":
+                            tmet.FASTGEN_COMPILE_ON_PATH.value - co0,
+                    }
+
+                hi = spec_leg(hi_prompts, sp_hi)
+                result["fastgen_spec_decode_tok_s"] = hi["on_tok_s"]
+                result["fastgen_spec_off_decode_tok_s"] = hi["off_tok_s"]
+                result["fastgen_spec_accept_rate"] = hi["accept_rate"]
+                result["fastgen_spec_programs_per_token"] = \
+                    hi["programs_per_token"]
+                result["fastgen_spec_compile_on_path_total"] = \
+                    hi["compile_on_path"]
+                lo = spec_leg(lo_prompts, sp_lo)
+                result["fastgen_spec_lowrep_decode_tok_s"] = lo["on_tok_s"]
+                result["fastgen_spec_lowrep_off_decode_tok_s"] = \
+                    lo["off_tok_s"]
+                result["fastgen_spec_lowrep_accept_rate"] = \
+                    lo["accept_rate"]
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: fastgen spec leg failed: {e}\n")
+                result["fastgen_spec_error"] = str(e)[:300]
         if os.environ.get("BENCH_CHAOS", "0") != "0":
             # chaos leg (ISSUE 7): the same workload under a ~10%
             # injected-fault rate (poisoned requests + KV-allocator

@@ -14,6 +14,7 @@ from .scheduler import FastGenScheduler, Request, RequestError, generate
 from .snapshot import (SNAPSHOT_VERSION, SnapshotError,
                        install_drain_handler, maybe_install_drain_handler,
                        read_bundle, write_bundle)
+from .spec import NgramDrafter
 
 __all__ = [
     "KVCacheUserConfig", "RaggedInferenceEngineConfig",
@@ -28,4 +29,5 @@ __all__ = [
     "FaultInjectionConfig", "KVAllocationError",
     "SNAPSHOT_VERSION", "SnapshotError", "install_drain_handler",
     "maybe_install_drain_handler", "read_bundle", "write_bundle",
+    "NgramDrafter",
 ]

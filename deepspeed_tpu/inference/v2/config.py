@@ -77,6 +77,23 @@ class ServingOptimizationConfig:
     #: bundle path the SIGTERM handler writes (with
     #: DS_DRAIN_ON_SIGTERM=1); empty = snapshot() explicit calls only
     snapshot_path: str = ""
+    # -- speculative decoding (ISSUE 10), default OFF: enabling changes
+    # nothing but throughput and the ds_fastgen_spec_* metrics ---------
+    #: model-free speculative decoding: draft up to ``spec_max_draft``
+    #: tokens per decode row from an n-gram/prompt-lookup suffix index
+    #: over the request's own prompt + committed tokens (no draft
+    #: model, no extra device memory) and verify them all in ONE fused
+    #: Q>1 program; accepted drafts commit as a block at drain.
+    #: Requires fused_step + on_device_sampling (the split path never
+    #: speculates)
+    speculative: bool = False
+    #: drafted tokens per decode row per program (the verify segment is
+    #: one ragged Q = 1 + spec_max_draft bucket)
+    spec_max_draft: int = 3
+    #: shortest trailing n-gram the prompt-lookup drafter matches on
+    #: (longer n-grams are tried first; raise to cut false drafts on
+    #: low-repetition traffic)
+    spec_ngram_min: int = 2
 
 
 @dataclasses.dataclass

@@ -50,7 +50,7 @@ class SchedulingError(RuntimeError):
 def lattice_keys(max_prompt: int, max_new_tokens: int,
                  max_concurrency: int, page_size: int,
                  max_ragged_batch_size: int, has_fresh: bool,
-                 sampling: bool) -> List[Tuple]:
+                 sampling: bool, spec_max_draft: int = 0) -> List[Tuple]:
     """Every (S, Q, P[, fresh[, kind, ...]]) step-cache key the default
     power-of-two bucket lattice contains for this geometry — the ONE
     enumeration shared by ``InferenceEngineV2.precompile`` (which
@@ -106,6 +106,22 @@ def lattice_keys(max_prompt: int, max_new_tokens: int,
                                     continue
                                 keys.append((S, 1, P, False, "chain",
                                              prev_s, greedy))
+    if sampling and spec_max_draft > 0:
+        # speculative verification buckets (ISSUE 10): decode rows
+        # dispatched as ragged Q = 1 + spec_max_draft segments.  One Q
+        # bucket covers every draft length (q_lens is dynamic); the
+        # same S*Q <= batch-size skip rule applies — a spec superbucket
+        # the scheduler can't form under strict shapes drops to the
+        # normal decode path, exactly like the mixed-step keys.
+        q_spec = _bucket(1 + spec_max_draft)
+        for S in s_vals:
+            if S * q_spec > max_ragged_batch_size:
+                continue
+            for P in p_vals:
+                if P * page_size < q_spec:
+                    continue
+                for greedy in (True, False):
+                    keys.append((S, q_spec, P, False, "spec", greedy))
     return keys
 
 
@@ -185,7 +201,8 @@ class InferenceEngineV2:
     def precompile(self, max_prompt: int, max_concurrency: int = 0,
                    max_new_tokens: int = 256,
                    strict: bool = False,
-                   sampling: bool = False) -> List[Tuple]:
+                   sampling: bool = False,
+                   spec_max_draft: Optional[int] = None) -> List[Tuple]:
         """AOT-compile the (S, Q, P) bucket lattice this engine can hit
         (verdict on live serving: a first-use XLA compile is a TTFT
         spike; the reference captures CUDA graphs at engine build).
@@ -200,9 +217,17 @@ class InferenceEngineV2:
         path.  ``sampling`` additionally lowers each superbucket's fused
         sample variants (greedy + stochastic) and, for decode buckets,
         the chained double-buffer step — the FastGenScheduler's hot path
-        when serving_optimization is on.  Returns the compiled keys."""
+        when serving_optimization is on.  ``spec_max_draft`` (default:
+        the serving config's, 0 when ``speculative`` is off) widens the
+        sampling lattice with the speculative Q = 1+draft verification
+        buckets so a strict_shapes engine can't recompile on-path when
+        speculation is enabled.  Returns the compiled keys."""
         sm = self._config.state_manager
         kv = self._state.kv_cache.data
+        if spec_max_draft is None:
+            sv = self._config.serving
+            spec_max_draft = (int(getattr(sv, "spec_max_draft", 0) or 0)
+                              if getattr(sv, "speculative", False) else 0)
         keys = lattice_keys(
             max_prompt=max_prompt, max_new_tokens=max_new_tokens,
             max_concurrency=(max_concurrency
@@ -211,7 +236,7 @@ class InferenceEngineV2:
             max_ragged_batch_size=sm.max_ragged_batch_size,
             has_fresh=getattr(self._model, "_fresh_attention",
                               None) is not None,
-            sampling=sampling)
+            sampling=sampling, spec_max_draft=spec_max_draft)
         for key in keys:
             self._model.precompile_step(key, kv)
         if strict:
@@ -333,17 +358,20 @@ class InferenceEngineV2:
                     # O(context)
                     self._state.evict_window(sd, window)
 
-    def _build_batch(self, descs, tokens, h2d_tokens: bool = True):
+    def _build_batch(self, descs, tokens, h2d_tokens: bool = True,
+                     min_q: int = 1):
         """Pack one segment; h2d bytes accrue here, program dispatches
         are recorded by the caller (a mixed step feeds TWO segments to
         ONE program).  ``h2d_tokens=False`` for chained steps, whose
         token ids never leave the device (the placeholder token_ids
-        array is not an input of the chained program)."""
+        array is not an input of the chained program); ``min_q`` floors
+        the Q bucket (spec steps pad to the one spec bucket)."""
         with trace_span("engine.build_batch"):
             batch = build_batch(
                 descs, tokens, self._model.kv_config.page_size,
                 fresh_supported=getattr(self._model, "_fresh_attention",
-                                        None) is not None)
+                                        None) is not None,
+                min_q=min_q)
             nbytes = (batch.q_lens.nbytes + batch.start_pos.nbytes
                       + batch.page_table.nbytes)
             if h2d_tokens:
@@ -405,15 +433,17 @@ class InferenceEngineV2:
         return out
 
     def predict_step_key(self, batch_uids: Sequence[int],
-                         batch_tokens: Sequence, suffix: tuple = ()
-                         ) -> tuple:
+                         batch_tokens: Sequence, suffix: tuple = (),
+                         min_q: int = 1) -> tuple:
         """The step-cache key a single-geometry dispatch of this batch
         will form, BEFORE admission — the strict-shapes scheduler gates
         fused dispatch on lattice membership of this prediction.  Must
         mirror ``build_batch``'s bucketing exactly (which is why it
         lives here, next to the live path, not in the scheduler).
         ``suffix`` extends the (S, Q, P, fresh) base: ``("sample",
-        greedy)`` or ``("chain", prev_len, greedy)``."""
+        greedy)``, ``("chain", prev_len, greedy)`` or ``("spec",
+        greedy)`` (the latter with ``min_q`` = the spec bucket floor,
+        and fresh pinned False — spec rows always have history)."""
         from .ragged.batch import MIN_PAGES, MIN_SLOTS, _bucket
         model = self._model
         page = model.kv_config.page_size
@@ -426,8 +456,8 @@ class InferenceEngineV2:
             if seen:
                 all_new = False
         S = _bucket(len(batch_uids), MIN_SLOTS)
-        Q = _bucket(max(len(t) for t in batch_tokens))
-        fresh = (all_new and Q > 1
+        Q = _bucket(max(max(len(t) for t in batch_tokens), min_q))
+        fresh = (all_new and Q > 1 and not suffix[:1] == ("spec",)
                  and getattr(model, "_fresh_attention", None) is not None)
         return (S, Q, _bucket(max(pages), MIN_PAGES), fresh) + suffix
 
@@ -535,6 +565,57 @@ class InferenceEngineV2:
             temps, top_ks, top_ps, greedy_only)
         self._commit_batch(descs)
         return tokens
+
+    def step_spec(self, batch_uids: Sequence[int],
+                  batch_tokens: Sequence[np.ndarray],
+                  row_params: Sequence, rng: jax.Array,
+                  min_q: int = 1) -> jax.Array:
+        """Speculative verification step (ISSUE 10): each row's tokens
+        are ``[last_committed, draft_1..draft_k]`` (k may differ per
+        row, k = 0 allowed) and ONE compiled program verifies every
+        draft through the ragged Q>1 path, returning a device [S, 2]
+        int32 array of (accepted_count, corrected_token) per row — the
+        only d2h of the step.  The commit is DEFERRED: the caller reads
+        the accepts and then calls :meth:`commit_spec` with each row's
+        committed token count (a step may commit 0..Q tokens per row,
+        which the one-shot ``post_forward`` bookkeeping can't express).
+        """
+        descs = self._admit_batch(batch_uids, batch_tokens,
+                                  do_checks=False)
+        # pad every spec dispatch to the ONE spec Q bucket (min_q =
+        # 1 + spec_max_draft from the caller): a short-draft step must
+        # not form a smaller off-lattice key
+        batch = self._build_batch(
+            descs, [np.asarray(t) for t in batch_tokens], min_q=min_q)
+        temps, top_ks, top_ps = self._pad_sample_params(
+            row_params, batch.num_slots)
+        greedy_only = not bool((temps > 0.0).any())
+        serving_counters.record_program(
+            h2d_bytes=temps.nbytes + top_ks.nbytes + top_ps.nbytes)
+        out, self._state.kv_cache.data = self._model.spec_step(
+            batch, self._state.kv_cache.data, rng, temps, top_ks,
+            top_ps, greedy_only)
+        return out
+
+    def commit_spec(self, batch_uids: Sequence[int],
+                    committed: Sequence[int]) -> None:
+        """Variable-advance commit of a :meth:`step_spec` dispatch:
+        each row's ``seen_tokens`` moves by its COMMITTED count (1 +
+        accepted drafts, possibly truncated at a stop token), never by
+        the dispatched width — rejected drafts' KV slots are simply
+        re-written by the next step (write-before-read), and generated
+        tokens are never prefix-indexed, so a rolled-back draft can't
+        poison a shared cache page."""
+        with trace_span("engine.commit"):
+            window = getattr(self._model.cfg, "sliding_window", None)
+            for uid, n in zip(batch_uids, committed):
+                sd = self._state.get_sequence(uid)
+                if sd is None:
+                    continue    # failed/evicted mid-step
+                sd.commit_tokens(int(n))
+                self._state.index_prefix(sd)
+                if window:
+                    self._state.evict_window(sd, window)
 
     # -- prefix cache (ISSUE 3) ---------------------------------------------
     def match_prefix(self, uid: int, prompt: Sequence[int]) -> int:

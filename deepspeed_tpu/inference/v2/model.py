@@ -316,6 +316,27 @@ class RaggedInferenceModel:
                     jnp.asarray(top_ks, jnp.int32),
                     jnp.asarray(top_ps, jnp.float32))
 
+    def spec_step(self, batch: RaggedBatch, kv: jax.Array,
+                  rng: jax.Array, temps, top_ks, top_ps,
+                  greedy_only: bool) -> Tuple[jax.Array, jax.Array]:
+        """Speculative verification step (ISSUE 10): each decode row
+        carries ``[last_committed, draft_1..draft_k]`` as a ragged
+        Q = 1+k segment; ONE compiled program runs the forward over
+        every position (the existing Q>1 kernel path with per-row causal
+        limits), computes the model's own emission at each position,
+        and reduces per row to ``[accepted_count, corrected_token]`` —
+        a [S, 2] int32 array, the ONLY thing that ever crosses d2h (the
+        host already knows the draft tokens it proposed, so counts +
+        one correction reconstruct the committed block)."""
+        key = self._normalize_key(batch.shape_key)[:3] + (
+            False, "spec", bool(greedy_only))
+        step = self._get_step(key)
+        return step(self.params, kv, batch.token_ids, batch.q_lens,
+                    batch.start_pos, batch.page_table, rng,
+                    jnp.asarray(temps, jnp.float32),
+                    jnp.asarray(top_ks, jnp.int32),
+                    jnp.asarray(top_ps, jnp.float32))
+
     def chained_step(self, batch: RaggedBatch, kv: jax.Array,
                      prev_tokens: jax.Array, gather_idx, rng: jax.Array,
                      temps, top_ks, top_ps, greedy_only: bool
@@ -497,6 +518,9 @@ class RaggedInferenceModel:
         if kind == "chain":
             return functools.partial(self._chained_step_impl,
                                      greedy_only=key[6])
+        if kind == "spec":
+            return functools.partial(self._spec_step_impl,
+                                     greedy_only=key[5])
         if kind == "mixed":
             # key = (S_d, 1, P_d, False, "mixed",
             #        S_p, Q, P_p, fresh_p, greedy_only)
@@ -519,7 +543,7 @@ class RaggedInferenceModel:
 
         if kind == "logits":
             return [self.params, kv_aval] + batch_avals
-        if kind == "sample":
+        if kind in ("sample", "spec"):
             return [self.params, kv_aval] + batch_avals + sample_avals(S)
         if kind == "mixed":
             S_p, Q_p, P_p = key[5:8]
@@ -548,8 +572,18 @@ class RaggedInferenceModel:
         self._note_program_cost(key, compiled)
         self._step_cache[key] = compiled
 
-    def _step_impl(self, params, kv, token_ids, q_lens, start_pos,
-                   page_table, fresh: bool = False):
+    def _lm_head(self, params):
+        cfg = self.cfg
+        return (params["embed"]["tokens"].astype(cfg.dtype).T
+                if cfg.tie_embeddings
+                else params["lm_head"].astype(cfg.dtype))
+
+    def _forward_hidden(self, params, kv, token_ids, q_lens, start_pos,
+                        page_table, fresh: bool = False):
+        """The shared trunk of every step kind: embed -> layers -> final
+        norm.  Returns (x [S, Q, E], new kv) — the step kinds differ
+        only in which positions they unembed (last-token gather for the
+        logits/sample kinds, EVERY position for the spec verify)."""
         cfg = self.cfg
         S, Q = token_ids.shape
         x = self._embed(params["embed"]["tokens"].astype(cfg.dtype),
@@ -577,11 +611,14 @@ class RaggedInferenceModel:
                 kv_layers.append(kv_i)
             kv = jnp.stack(kv_layers)
 
-        x = self._norm(params["final_norm"], x)
-        head = (params["embed"]["tokens"].astype(cfg.dtype).T
-                if cfg.tie_embeddings
-                else params["lm_head"].astype(cfg.dtype))
-        logits = self._unembed(x, q_lens, head)             # [S, V]
+        return self._norm(params["final_norm"], x), kv
+
+    def _step_impl(self, params, kv, token_ids, q_lens, start_pos,
+                   page_table, fresh: bool = False):
+        cfg = self.cfg
+        x, kv = self._forward_hidden(params, kv, token_ids, q_lens,
+                                     start_pos, page_table, fresh=fresh)
+        logits = self._unembed(x, q_lens, self._lm_head(params))  # [S, V]
         if "lm_head_bias" in params:  # phi family ships an lm_head bias
             logits = logits + params["lm_head_bias"].astype(cfg.dtype)
         return logits.astype(jnp.float32), kv
@@ -610,6 +647,50 @@ class RaggedInferenceModel:
         return self._sample_step_impl(
             params, kv, token_ids, q_lens, start_pos, page_table, rng,
             temps, top_ks, top_ps, fresh=False, greedy_only=greedy_only)
+
+    def _spec_step_impl(self, params, kv, token_ids, q_lens, start_pos,
+                        page_table, rng, temps, top_ks, top_ps,
+                        greedy_only: bool = False):
+        """Verify drafted tokens in one traced program.  Row layout:
+        ``token_ids[s] = [last_committed, d_1..d_k, pad...]`` with
+        ``q_lens[s] = 1 + k`` (k may be 0).  The forward writes KV for
+        every valid position (rejected drafts land in pages the next
+        step overwrites write-before-read — the chained step's
+        optimistic-token discipline, generalized) and emits the model's
+        own next token at EVERY position.  Per row: the accepted count
+        is the longest prefix of drafts matching the model's emissions
+        (greedy: argmax exact-match, so committed tokens are bit-equal
+        to non-speculative greedy; stochastic: ``sample_dynamic``'s own
+        draw at each position — the emitted token is ALWAYS the model's
+        sample, drafts only decide how many positions commit at once),
+        plus the correction/bonus token at position ``accepted``.
+        Returns [S, 2] int32: (accepted_count, corrected_token)."""
+        x, kv = self._forward_hidden(params, kv, token_ids, q_lens,
+                                     start_pos, page_table, fresh=False)
+        logits = jnp.einsum("sqe,ev->sqv", x, self._lm_head(params))
+        if "lm_head_bias" in params:
+            logits = logits + params["lm_head_bias"].astype(self.cfg.dtype)
+        logits = logits.astype(jnp.float32)                  # [S, Q, V]
+        S, Q, V = logits.shape
+        if greedy_only:
+            emitted = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            from .sampling import sample_dynamic
+            emitted = sample_dynamic(
+                logits.reshape(S * Q, V), rng,
+                jnp.repeat(temps, Q), jnp.repeat(top_ks, Q),
+                jnp.repeat(top_ps, Q)).reshape(S, Q)
+        # accepted = leading run of draft positions whose draft equals
+        # the model's emission ONE POSITION EARLIER (emitted[j] is the
+        # model's choice for the token AT input position j+1)
+        drafts = token_ids[:, 1:]                            # [S, Q-1]
+        col = jnp.arange(Q - 1, dtype=jnp.int32)[None, :]
+        ok = (emitted[:, :-1] == drafts) & (col < (q_lens - 1)[:, None])
+        accepts = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1),
+                          axis=1).astype(jnp.int32)          # [S]
+        corrected = jnp.take_along_axis(emitted, accepts[:, None],
+                                        axis=1)[:, 0]
+        return jnp.stack([accepts, corrected], axis=1), kv   # [S, 2]
 
     def _mixed_sample_step_impl(self, params, kv, d_tok, d_ql, d_sp,
                                 d_pt, p_tok, p_ql, p_sp, p_pt, rng,

@@ -78,7 +78,8 @@ def build_batch(seqs: Sequence[SequenceDescriptor],
                 page_size: int,
                 min_slots: int = MIN_SLOTS,
                 min_pages: int = MIN_PAGES,
-                fresh_supported: bool = True) -> RaggedBatch:
+                fresh_supported: bool = True,
+                min_q: int = 1) -> RaggedBatch:
     """Pack (descriptor, new-token) pairs into a bucketed RaggedBatch.
 
     Callers must already have reserved KV pages on each descriptor
@@ -91,11 +92,16 @@ def build_batch(seqs: Sequence[SequenceDescriptor],
     contains (``precompile`` only lowers the True variant when the model
     has ``_fresh_attention``), spuriously raising under ``strict_shapes``
     or recompiling on the request path.
+
+    ``min_q`` floors the Q bucket: speculative verification steps pad
+    every dispatch to the ONE ``1 + spec_max_draft`` bucket so a
+    short-draft step can't form a smaller off-lattice Q key (one
+    compiled spec program per (S, P), not one per draft-length mix).
     """
     n = len(seqs)
     assert n == len(tokens) and n >= 1
     S = _bucket(n, min_slots)
-    Q = _bucket(max(len(t) for t in tokens))
+    Q = _bucket(max(max(len(t) for t in tokens), min_q))
     P = _bucket(max(max(s.allocated_capacity for s in seqs), 1), min_pages)
 
     token_ids = np.zeros((S, Q), dtype=np.int32)

@@ -23,6 +23,16 @@ PREVIOUS step's tokens).  Requests that hit a stop token are detected at
 drain time; the one optimistically-dispatched extra token is discarded
 and its KV write is harmless (the flushed pages return to the pool and
 every page position is write-before-read for its next owner).
+
+Speculative decoding (ISSUE 10, ``serving_optimization.speculative``,
+default off): on steady-state decode steps a host-side prompt-lookup
+drafter (spec.py) proposes up to ``spec_max_draft`` tokens per row and
+ONE fused program verifies them all as ragged Q>1 segments, returning
+``[S, 2]`` int32 (accepted count + corrected token) — a step may then
+commit 0..Q tokens per row (``engine.commit_spec`` variable advance,
+stop tokens truncate inside accepted blocks).  ``on_token`` is the
+complete per-token delivery; the ``step()`` dict keeps one (the last)
+token per uid.
 """
 
 from __future__ import annotations
@@ -51,6 +61,7 @@ from .sampling import SamplingParams, sample
 from .snapshot import (SNAPSHOT_VERSION, SnapshotError,
                        maybe_install_drain_handler, read_bundle,
                        write_bundle)
+from .spec import NgramDrafter
 
 
 @dataclasses.dataclass
@@ -87,6 +98,11 @@ class Request:
     first_sched_mono: float = 0.0
     first_token_mono: float = 0.0
     last_token_mono: float = 0.0
+    #: speculative decoding facts (ISSUE 10): tokens this request had
+    #: drafted for it and tokens verification accepted — the workload
+    #: ledger records both so the analyzer can recommend spec_max_draft
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def prefill_remaining(self) -> int:
@@ -237,6 +253,29 @@ class FastGenScheduler:
         #: capture hook below
         self._wtrace = get_workload_trace()
         self._bind_backlog_gauges()
+        # -- speculative decoding (ISSUE 10) --------------------------
+        self._spec_cfg = bool(getattr(sv, "speculative", False))
+        self._spec_max_draft = max(
+            int(getattr(sv, "spec_max_draft", 3) or 0), 0)
+        self._drafter = (NgramDrafter(
+            max(int(getattr(sv, "spec_ngram_min", 2) or 1), 1))
+            if self._spec_cfg and self._spec_max_draft else None)
+        #: consecutive fruitless spec attempts (nothing drafted or
+        #: nothing accepted) and the backoff window they open — while
+        #: cooling down, the scheduler keeps the normal (chain-capable)
+        #: path so a draft-less workload keeps the async double-buffer
+        #: overlap (a spec attempt must drain the in-flight step first:
+        #: the host drafter needs the committed tokens)
+        self._spec_dry = 0
+        self._spec_cooldown = 0
+        #: strict-shapes latches (the `_fused_ready` pattern): a strict
+        #: engine either has spec buckets compiled (positive latch) or
+        #: never will (negative latch + one warning)
+        self._spec_strict_ready = False
+        self._warned_strict_spec = False
+        #: cumulative drafted/accepted behind ds_fastgen_spec_accept_rate
+        self._spec_drafted_cum = 0
+        self._spec_accepted_cum = 0
         self._snapshot_grace_s = float(
             getattr(sv, "snapshot_grace_s", 5.0) or 0.0)
         self._snapshot_path = str(getattr(sv, "snapshot_path", "") or "")
@@ -302,7 +341,9 @@ class FastGenScheduler:
                     / (n - 1)
                     if n > 1 and req.first_token_mono else None),
             queue_wait_ms=((req.first_sched_mono - req.submit_mono) * 1e3
-                           if req.first_sched_mono else None))
+                           if req.first_sched_mono else None),
+            spec_drafted=req.spec_drafted,
+            spec_accepted=req.spec_accepted)
 
     def _trace_token(self, req: Request) -> None:
         """Stamp one host-visible token (capture-on path only)."""
@@ -406,6 +447,8 @@ class FastGenScheduler:
         self._pending = [r for r in self._pending if r.uid != req.uid]
         self._running.pop(req.uid, None)
         self._preempted.pop(req.uid, None)
+        if self._drafter is not None:
+            self._drafter.drop(req.uid)
         if self._engine.state_manager.get_sequence(req.uid) is not None:
             self._engine.flush(req.uid)
         self.errors[req.uid] = RequestError(
@@ -514,6 +557,39 @@ class FastGenScheduler:
         req.slo_gen = _telemetry.generation
 
     # -- drain: sync a dispatched step's tokens ------------------------------
+    def _deliver_token(self, req: Request, tok: int, out: Dict[int, int],
+                       on_token) -> bool:
+        """Append ONE committed token and run the delivery sequence
+        (SLO stamp, ledger stamp, out dict, callback) shared by every
+        drain path — spec blocks included.  Returns True when this
+        token terminates the request (max_new_tokens reached or stop
+        token hit); the caller then runs :meth:`_finish_request`."""
+        req.generated.append(tok)
+        if _telemetry.enabled:
+            self._note_token_slo(req)
+        if self._wtrace.active:
+            self._trace_token(req)
+        out[req.uid] = tok
+        if on_token is not None:
+            on_token(req.uid, tok)
+        stop = req.params.stop_token
+        return (len(req.generated) >= req.params.max_new_tokens
+                or (stop is not None and tok == stop))
+
+    def _finish_request(self, req: Request) -> None:
+        """Normal (outcome "ok") request termination, one copy for all
+        drain paths: flush engine state, leave the running set, drop
+        the drafter index, close the workload-ledger record."""
+        req.done = True
+        get_flight_recorder().record("request.done", uid=req.uid,
+                                     tokens=len(req.generated))
+        self._engine.flush(req.uid)
+        self._running.pop(req.uid, None)
+        if self._drafter is not None:
+            self._drafter.drop(req.uid)
+        if self._wtrace.active:
+            self._trace_finish(req, "ok")
+
     def _drain(self, on_token) -> Dict[int, int]:
         if self._inflight is None:
             return {}
@@ -531,25 +607,8 @@ class FastGenScheduler:
                 # sampled token is discarded (its KV write landed in
                 # pages the flush already returned to the pool)
                 continue
-            tok = int(toks[row])
-            req.generated.append(tok)
-            if _telemetry.enabled:
-                self._note_token_slo(req)
-            if self._wtrace.active:
-                self._trace_token(req)
-            out[uid] = tok
-            if on_token is not None:
-                on_token(uid, tok)
-            stop = req.params.stop_token
-            if (len(req.generated) >= req.params.max_new_tokens
-                    or (stop is not None and tok == stop)):
-                req.done = True
-                get_flight_recorder().record(
-                    "request.done", uid=uid, tokens=len(req.generated))
-                self._engine.flush(uid)
-                self._running.pop(uid, None)
-                if self._wtrace.active:
-                    self._trace_finish(req, "ok")
+            if self._deliver_token(req, int(toks[row]), out, on_token):
+                self._finish_request(req)
         return out
 
     # -- double buffer: chained decode dispatch ------------------------------
@@ -603,19 +662,190 @@ class FastGenScheduler:
                          rows=[(u, i, req)
                                for i, (u, _, req) in enumerate(rows)])
 
-    def _strict_key_ok(self, uids, tokens, suffix: tuple) -> bool:
+    def _strict_key_ok(self, uids, tokens, suffix: tuple,
+                       min_q: int = 1) -> bool:
         """Under strict shapes, fused dispatch requires the predicted
         step-cache key to be AOT-compiled.  Slot/Q bucketing can push
         bucket(S) * bucket(Q) past max_ragged_batch_size even when the
         actual token count fits the budget — exactly the superbuckets
         the precompile lattice skips — so membership, not arithmetic, is
-        the gate.  ``suffix`` is () for a logits key or
-        ("sample", greedy_only)."""
+        the gate.  ``suffix`` is () for a logits key,
+        ("sample", greedy_only), or ("spec", greedy_only) with
+        ``min_q`` the spec Q-bucket floor."""
         model = self._engine.model
         if not getattr(model, "strict_shapes", False):
             return True
-        key = self._engine.predict_step_key(uids, tokens, suffix)
+        key = self._engine.predict_step_key(uids, tokens, suffix,
+                                            min_q=min_q)
         return key in model._step_cache
+
+    # -- speculative decoding (ISSUE 10) -------------------------------------
+    #: dry-spell backoff ceiling: after N consecutive fruitless
+    #: attempts (nothing drafted, or nothing accepted) speculation is
+    #: re-attempted at most every N+1 steps
+    _SPEC_BACKOFF_MAX = 8
+
+    @property
+    def _spec_on(self) -> bool:
+        """Speculation gate, strict-shapes coherent (the `_fused`
+        pattern): a strict engine whose precompiled lattice has NO spec
+        buckets latches speculation off for the life of this scheduler
+        — without the latch every backoff re-probe would drain the
+        in-flight chain step and draft for every row just to fail the
+        key-membership check, a permanent throughput tax."""
+        if self._drafter is None or not self._fused:
+            return False
+        model = self._engine.model
+        if not getattr(model, "strict_shapes", False):
+            return True
+        if self._spec_strict_ready:
+            return True
+        if self._warned_strict_spec:
+            return False    # negative latch: don't rescan the cache
+        if any(len(k) > 4 and k[4] == "spec" for k in model._step_cache):
+            self._spec_strict_ready = True
+            return True
+        from ...utils.logging import logger
+        logger.warning(
+            "strict_shapes engine has no precompiled speculative "
+            "buckets — speculation disabled for the life of this "
+            "scheduler; precompile with sampling=True on an engine "
+            "config with serving.speculative=True (or pass "
+            "spec_max_draft to precompile) to serve it")
+        self._warned_strict_spec = True
+        return False
+
+    def _spec_gate(self) -> bool:
+        """Preconditions for attempting a speculative step: pure
+        steady-state decode (the chained path's membership conditions)
+        and not inside a dry-spell cooldown.  An attempt costs the
+        async overlap (the in-flight step must drain before the host
+        drafter can see committed tokens), and a zero-accept dispatch
+        costs a Q-wide verify for one token — so fruitless attempts
+        back off linearly (capped) instead of retrying every step, and
+        an accepted draft resets the backoff."""
+        if not self._spec_on or self._pending or self._preempted \
+                or not self._running:
+            return False
+        if any(r.prefill_remaining > 0 for r in self._running.values()):
+            return False
+        if self._spec_cooldown > 0:
+            self._spec_cooldown -= 1
+            return False
+        return True
+
+    def _spec_fruitless(self) -> None:
+        self._spec_dry += 1
+        self._spec_cooldown = min(self._spec_dry, self._SPEC_BACKOFF_MAX)
+
+    def _plan_spec(self):
+        """Draft + admission plan for one speculative step: every
+        running row gets ``[last_committed, draft...]`` tokens (draft
+        possibly empty — rows verify raggedly within the one spec
+        bucket).  Returns ``[(uid, req, tokens, draft), ...]`` or None
+        when nothing drafted / budget refused / strict-uncovered —
+        callers fall back to the normal paths.  Must run AFTER the
+        in-flight step drained (the drafter reads committed tokens)."""
+        adm = _Admission(self._engine, self._budget)
+        max_seq = int(getattr(self._engine.model.cfg, "max_seq_len",
+                              1 << 30))
+        rows = []
+        any_draft = False
+        for uid, req in self._running.items():
+            # room for the mandatory 1 corrected/bonus token + drafts:
+            # never draft past max_new_tokens or the model context
+            room = min(self._spec_max_draft,
+                       req.params.max_new_tokens - len(req.generated) - 1,
+                       max_seq - self._engine.seen_tokens(uid) - 2)
+            draft = (self._drafter.propose(uid, req.prompt,
+                                           req.generated, room)
+                     if room > 0 else np.zeros(0, np.int32))
+            last = (req.generated[-1] if req.generated
+                    else int(req.prompt[-1]))
+            toks = np.concatenate(
+                [np.asarray([last], np.int32), draft])
+            if not adm.try_admit(uid, len(toks), is_new=False):
+                # shrink to a plain decode row before giving up on the
+                # whole step
+                if len(toks) > 1 and adm.try_admit(uid, 1, is_new=False):
+                    toks, draft = toks[:1], draft[:0]
+                else:
+                    return None     # host path handles preemption
+            if len(draft):
+                any_draft = True
+            rows.append((uid, req, toks, draft))
+        if not rows or not any_draft:
+            return None
+        greedy_only = all(req.params.temperature <= 0.0
+                          for _, req, _, _ in rows)
+        if not self._strict_key_ok(
+                [u for u, _, _, _ in rows],
+                [t for _, _, t, _ in rows], ("spec", greedy_only),
+                min_q=1 + self._spec_max_draft):
+            return None
+        return rows
+
+    def _dispatch_spec(self, rows, on_token) -> Dict[int, int]:
+        """Dispatch one speculative verification program and drain it
+        in the SAME scheduler step: the device returns [S, 2] int32
+        (accepted count, corrected token) per row — the only d2h —
+        and the host reconstructs each committed block from the drafts
+        it proposed.  Commit is variable-advance: ``seen_tokens`` moves
+        by the committed count only; rejected drafts' KV is overwritten
+        write-before-read by later steps.  A stop token INSIDE an
+        accepted block truncates the commit at the stop (the request
+        flushes, so the over-written KV beyond it is unreachable)."""
+        uids = [u for u, _, _, _ in rows]
+        toks = [t for _, _, t, _ in rows]
+        params = [req.params for _, req, _, _ in rows]
+        greedy_only = all(p.temperature <= 0.0 for p in params)
+        with trace_span("fastgen.dispatch.spec"):
+            out_dev = self._engine.step_spec(
+                uids, toks, params, self._next_key(greedy_only),
+                min_q=1 + self._spec_max_draft)
+        self.last_step_scheduled = len(uids)
+        av = np.asarray(out_dev)            # the ONLY d2h: [S, 2] int32
+        serving_counters.record_d2h(av.nbytes)
+        out: Dict[int, int] = {}
+        committed: List[int] = []
+        drafted = accepted = 0
+        for i, (uid, req, _t, draft) in enumerate(rows):
+            a = min(int(av[i, 0]), len(draft))
+            block = [int(t) for t in draft[:a]] + [int(av[i, 1])]
+            c = 0
+            for tok in block:
+                c += 1
+                if self._deliver_token(req, tok, out, on_token):
+                    # termination deferred: flush needs the descriptor
+                    # the variable-advance commit below still updates
+                    req.done = True
+                    break
+            committed.append(c)
+            # accepted counts COMMITTED drafts only: a stop-token
+            # truncation rolls back verifier-accepted tokens past it,
+            # and the accept-rate the analyzer mines must reflect what
+            # actually committed (c <= a: all c are drafts; c == a+1:
+            # the a drafts plus the correction)
+            drafted += len(draft)
+            accepted += min(a, c)
+            req.spec_drafted += len(draft)
+            req.spec_accepted += min(a, c)
+        self._engine.commit_spec(uids, committed)
+        for uid, req, _t, _d in rows:
+            if req.done:
+                self._finish_request(req)
+        if accepted:
+            self._spec_dry = self._spec_cooldown = 0
+        else:
+            self._spec_fruitless()
+        self._spec_drafted_cum += drafted
+        self._spec_accepted_cum += accepted
+        tm.FASTGEN_SPEC_DRAFTED.inc(drafted)
+        tm.FASTGEN_SPEC_ACCEPTED.inc(accepted)
+        if self._spec_drafted_cum:
+            tm.FASTGEN_SPEC_ACCEPT_RATE.set(
+                self._spec_accepted_cum / self._spec_drafted_cum)
+        return out
 
     # -- one engine step -----------------------------------------------------
     def step(self, on_token: Optional[Callable[[int, int], None]] = None
@@ -623,7 +853,11 @@ class FastGenScheduler:
         """Schedule one ragged batch; returns {uid: new_token} for every
         sequence whose token became host-visible this step (with
         async_scheduling that is the PREVIOUS step's tokens — one-step
-        lag)."""
+        lag).  With speculation enabled a step may commit a whole
+        accepted BLOCK per row; the dict then holds each row's LAST
+        committed token, and ``on_token`` (called once per token, in
+        order) is the complete delivery path — stream consumers must
+        use it, not the return value."""
         _faults = get_fault_injector()
         if _faults.armed and _faults.fire("serving.preempt"):
             # deterministic SIGTERM-equivalent at a step BOUNDARY
@@ -701,7 +935,27 @@ class FastGenScheduler:
         self._preempted_this_step = False
         self._expire_requests()
 
-        chain = self._plan_chain()
+        spec_drained: Optional[Dict[int, int]] = None
+        if self._spec_gate():
+            # speculation needs the committed token stream on the host
+            # (the drafter's n-gram key ends at the LAST token), so the
+            # in-flight chained step drains first; if nothing drafts,
+            # fall through to the normal admission path with the drain
+            # already done (the chain plan needs an in-flight step)
+            spec_drained = self._drain(on_token)
+            rows = self._plan_spec()
+            if rows is not None:
+                try:
+                    out = self._dispatch_spec(rows, on_token)
+                except KVAllocationError as e:
+                    self._degrade_oom(e, [], [])
+                    return spec_drained
+                self._oom_streak = 0
+                spec_drained.update(out)
+                return spec_drained
+            self._spec_fruitless()
+
+        chain = self._plan_chain() if spec_drained is None else None
         if chain is not None:
             # dispatch k+1 FIRST, then drain k: the host sync below
             # overlaps the device executing the new step
@@ -719,7 +973,8 @@ class FastGenScheduler:
             self._inflight = new_inflight
             return out
 
-        out_prev = self._drain(on_token)
+        out_prev = (spec_drained if spec_drained is not None
+                    else self._drain(on_token))
 
         with trace_span("fastgen.admission"):
             # resume preempted sequences first when the pool has room
@@ -932,25 +1187,8 @@ class FastGenScheduler:
         out = dict(out_prev)
         for i, tok in new_tokens.items():
             req = reqs[i]
-            req.generated.append(tok)
-            if _telemetry.enabled:
-                self._note_token_slo(req)
-            if self._wtrace.active:
-                self._trace_token(req)
-            out[req.uid] = tok
-            if on_token is not None:
-                on_token(req.uid, tok)
-            stop = req.params.stop_token
-            if (len(req.generated) >= req.params.max_new_tokens
-                    or (stop is not None and tok == stop)):
-                req.done = True
-                get_flight_recorder().record(
-                    "request.done", uid=req.uid,
-                    tokens=len(req.generated))
-                self._engine.flush(req.uid)
-                del self._running[req.uid]
-                if self._wtrace.active:
-                    self._trace_finish(req, "ok")
+            if self._deliver_token(req, tok, out, on_token):
+                self._finish_request(req)
         return out
 
     # -- graceful degradation (ISSUE 7) --------------------------------------
@@ -1065,7 +1303,13 @@ class FastGenScheduler:
                 # deadlines are monotonic-clock absolute — only the
                 # REMAINING budget survives a process boundary
                 "ttl_remaining_s": (None if req.deadline is None
-                                    else req.deadline - now)}
+                                    else req.deadline - now),
+                # speculation facts ride along so the workload ledger's
+                # accept-rate mining stays correct across a migration
+                # (spec steps drain in-step, so a snapshot never holds
+                # undrained speculative state — committed tokens only)
+                "spec_drafted": int(req.spec_drafted),
+                "spec_accepted": int(req.spec_accepted)}
 
     def _restore_request(self, d: dict, now: float) -> Request:
         pr = d["params"]
@@ -1084,6 +1328,8 @@ class FastGenScheduler:
         # latency/SLO stamps are process-relative and deliberately not
         # captured; the shed valve's always-on stamp restarts here
         req.submit_mono = now
+        req.spec_drafted = int(d.get("spec_drafted", 0))
+        req.spec_accepted = int(d.get("spec_accepted", 0))
         ttl = d.get("ttl_remaining_s")
         if ttl is not None:
             req.deadline = now + float(ttl)

@@ -461,6 +461,16 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
     #: bundle path the SIGTERM handler writes (with
     #: DS_DRAIN_ON_SIGTERM=1); empty = explicit snapshot() calls only
     snapshot_path: str = ""
+    # -- speculative decoding (ISSUE 10), default off ------------------
+    #: model-free speculative decoding: n-gram/prompt-lookup drafts
+    #: verified Q-at-a-time inside the fused step; accepted drafts
+    #: commit as a block at drain.  Enabling changes only throughput
+    #: and the ds_fastgen_spec_* metrics
+    speculative: bool = False
+    #: drafted tokens per decode row per program
+    spec_max_draft: int = 3
+    #: shortest trailing n-gram the prompt-lookup drafter matches on
+    spec_ngram_min: int = 2
 
     def to_v2_dict(self) -> Dict[str, Any]:
         """The ``serving_optimization`` dict the inference-v2 config
@@ -474,7 +484,10 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
                 "default_ttl_s": self.default_ttl_s,
                 "shed_unservable": self.shed_unservable,
                 "snapshot_grace_s": self.snapshot_grace_s,
-                "snapshot_path": self.snapshot_path}
+                "snapshot_path": self.snapshot_path,
+                "speculative": self.speculative,
+                "spec_max_draft": self.spec_max_draft,
+                "spec_ngram_min": self.spec_ngram_min}
 
 
 class TPUConfig(DeepSpeedConfigModel):

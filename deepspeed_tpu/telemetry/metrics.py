@@ -184,6 +184,18 @@ FASTGEN_BYTES_PER_S = registry.gauge(
     "serving HBM traffic rate: dispatched program bytes accessed / "
     "wall since the cost window opened")
 
+# -- speculative decoding (ISSUE 10) -----------------------------------------
+FASTGEN_SPEC_DRAFTED = registry.counter(
+    "ds_fastgen_spec_drafted_total",
+    "draft tokens proposed by the prompt-lookup drafter and dispatched "
+    "for fused verification")
+FASTGEN_SPEC_ACCEPTED = registry.counter(
+    "ds_fastgen_spec_accepted_total",
+    "draft tokens accepted by on-device verification and committed")
+FASTGEN_SPEC_ACCEPT_RATE = registry.gauge(
+    "ds_fastgen_spec_accept_rate",
+    "cumulative accepted/drafted ratio of speculative decoding")
+
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(
     "ds_fastgen_ttft_ms", "time to first token, submit -> host-visible")

@@ -174,9 +174,14 @@ class WorkloadTrace:
                        top_p: float, max_new_tokens: int, outcome: str,
                        ttft_ms: Optional[float],
                        itl_ms: Optional[float],
-                       queue_wait_ms: Optional[float]) -> None:
+                       queue_wait_ms: Optional[float],
+                       spec_drafted: int = 0,
+                       spec_accepted: int = 0) -> None:
         """One terminated request (scheduler drain/error point).  Only
-        lengths, digests, params and latencies — never token ids."""
+        lengths, digests, params, latencies and speculation counts —
+        never token ids.  ``spec_drafted``/``spec_accepted`` are this
+        request's speculative-decoding facts (ISSUE 10): the analyzer
+        mines accept rates from them to recommend ``spec_max_draft``."""
         if not self.active:
             return
         rec = {
@@ -195,6 +200,8 @@ class WorkloadTrace:
             "itl_ms": None if itl_ms is None else round(itl_ms, 3),
             "queue_wait_ms": (None if queue_wait_ms is None
                               else round(queue_wait_ms, 3)),
+            "spec_drafted": int(spec_drafted),
+            "spec_accepted": int(spec_accepted),
         }
         with self._lock:
             if not self.active:

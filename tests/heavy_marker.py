@@ -60,6 +60,11 @@ CHAOS_TESTS = frozenset([
 ])
 
 HEAVY_TESTS = frozenset([
+    "tests/test_spec_decoding.py::TestStrictSpec::test_strict_spec_lattice",  # 16.7s, full sampling+spec lattice AOT (newly added)
+    "tests/test_spec_decoding.py::TestStrictSpec::test_strict_without_spec_buckets_latches_off",  # ~14s, full sampling lattice AOT (newly added)
+    "tests/test_spec_decoding.py::TestSpecParity::test_mixed_workload_parity",  # 6.7s, 3 serving variants (newly added)
+    "tests/test_spec_decoding.py::TestSpecParity::test_preemption_mid_spec",  # 4.2s, tiny-pool engines (newly added)
+    "tests/test_serving_snapshot.py::TestSnapshotRestoreParity::test_interrupt_every_step_ordinal_speculative",  # ~10s, ordinal sweep with spec on (newly added)
     "tests/test_workload_trace.py::TestCostAccounting::test_precompiled_and_on_path_costs_agree",  # 6.5s, 2 engine builds + small precompile lattice (newly added)
     "tests/test_prefix_cache.py::TestServingParity::test_parity_under_preemption",  # 11.5s, small-pool engine build (newly added)
     "tests/test_prefix_cache.py::TestServingParity::test_parity_sliding_window_model",  # 4.0s, windowed engine build (newly added)

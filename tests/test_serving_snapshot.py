@@ -137,8 +137,10 @@ def _interrupted(model, prompts, params, k, serving=None, seed=7,
     got = {}
     steps = 0
     while s1.has_work and steps < k:
-        for uid, tok in s1.step().items():
-            got.setdefault(uid, []).append(tok)
+        # on_token is the complete delivery path: a speculative step
+        # commits a whole accepted block per row, which the step()
+        # return dict (one entry per uid) collapses
+        s1.step(on_token=lambda u, t: got.setdefault(u, []).append(t))
         steps += 1
     if not s1.has_work:
         return got, False, s1
@@ -280,6 +282,61 @@ class TestSnapshotRestoreParity:
                 break
             covered_interrupt += 1
         assert covered_interrupt >= 3  # the sweep really interrupted
+
+    def test_interrupt_every_step_ordinal_speculative(self, main_model,
+                                                      tmp_path):
+        """ISSUE 10: snapshot/restore round-trips a SPECULATING
+        scheduler at every step ordinal.  Spec steps drain in-step, so
+        a snapshot only ever captures verified/committed tokens —
+        rejected drafts' KV never rides the bundle — and the restored
+        scheduler (fresh drafter, rebuilt lazily from prompt+generated)
+        resumes tokenwise identical, with the per-request
+        drafted/accepted ledger counts surviving the boundary."""
+        from deepspeed_tpu.inference.v2 import ServingOptimizationConfig
+        spec = ServingOptimizationConfig(speculative=True)
+        rng = np.random.default_rng(5)
+        # loopy constants make speculation really fire; one random
+        # prompt keeps a non-drafting row in the batch
+        prompts = [[7] * 24, [9] * 40,
+                   rng.integers(0, 128, 19).tolist()]
+        sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+        base = _baseline(main_model, prompts, sp, serving=spec)
+        path = str(tmp_path / "spec.snap")
+        covered = 0
+        spec_seen = 0
+        for k in range(1, 32):
+            got, interrupted, s1 = _interrupted(
+                main_model, prompts, sp, k, serving=spec, via_path=path)
+            assert got == base, f"divergence at spec interrupt {k}"
+            spec_seen = max(spec_seen, s1._spec_drafted_cum)
+            if not interrupted:
+                break
+            covered += 1
+        assert covered >= 3
+        # speculation really engaged somewhere in the sweep — the
+        # parity claim is about a SPECULATING scheduler, not a no-op
+        assert spec_seen > 0
+
+    def test_spec_counts_survive_restore(self, main_model, tmp_path):
+        """The per-request drafted/accepted counts (workload-ledger
+        facts) ride the bundle."""
+        from deepspeed_tpu.inference.v2 import ServingOptimizationConfig
+        spec = ServingOptimizationConfig(speculative=True)
+        prompts = [[7] * 24, [9] * 40]
+        sp = SamplingParams(max_new_tokens=24, temperature=0.0)
+        s1 = FastGenScheduler(_engine(main_model), rng=jax.random.key(7),
+                              serving=spec)
+        _submit_all(s1, prompts, sp)
+        for _ in range(6):
+            s1.step()
+        drafted = {u: r.spec_drafted for u, r in s1._running.items()}
+        assert any(v > 0 for v in drafted.values())
+        bundle = s1.snapshot()
+        s2 = FastGenScheduler(_engine(main_model), rng=jax.random.key(7),
+                              serving=spec)
+        s2.restore(bundle)
+        for u, r in s2._running.items():
+            assert r.spec_drafted == drafted[u]
 
     def test_interrupt_stochastic_rng_parity(self, main_model):
         """Sampled paths resume identically: the serialized RNG key

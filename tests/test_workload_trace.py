@@ -119,7 +119,7 @@ def _workload(eng, n=8, seed=0, max_new=6, shared_pages=2,
 REQUEST_KEYS = {"kind", "uid", "arrival_s", "prompt_len", "gen_len",
                 "digests", "temperature", "top_k", "top_p",
                 "max_new_tokens", "outcome", "ttft_ms", "itl_ms",
-                "queue_wait_ms"}
+                "queue_wait_ms", "spec_drafted", "spec_accepted"}
 
 
 class TestLedger:

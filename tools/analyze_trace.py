@@ -81,6 +81,28 @@ def fit_buckets(lengths: Sequence[int], ratio: float = 1.3,
 _pct = replay_trace.percentile
 
 
+def recommend_spec_max_draft(accept_rate: float, cap: int = 8) -> int:
+    """Recommend ``spec_max_draft`` from an observed per-draft accept
+    rate ``p``: expected committed tokens per program with k drafts is
+    the truncated geometric sum ``E(k) = (1 - p^(k+1)) / (1 - p)``,
+    which saturates fast — pick the smallest k within 95% of the
+    ``cap``-draft asymptote, so low accept rates recommend short (or
+    zero) drafts and high rates recommend long ones without ever
+    paying verify width that can't pay for itself."""
+    p = min(max(float(accept_rate), 0.0), 0.999)
+    if p <= 0.0:
+        return 0
+
+    def expected(k: int) -> float:
+        return (1.0 - p ** (k + 1)) / (1.0 - p)
+
+    target = 0.95 * expected(cap)
+    for k in range(1, cap + 1):
+        if expected(k) >= target:
+            return k
+    return cap
+
+
 def _concurrency_estimate(requests: List[Dict[str, Any]]) -> int:
     """Max overlap of [arrival, completion] intervals, completion
     approximated from the recorded latency facts (TTFT + (n-1) * mean
@@ -134,12 +156,17 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
     # -- current-lattice coverage (the ONE shared enumeration) --------
     from deepspeed_tpu.inference.v2.engine import lattice_keys
     mc = max_concurrency or max(concurrency, 1)
+    # spec keys in the traffic imply speculation was on: widen the
+    # current lattice with the observed spec Q bucket so enabled
+    # speculation isn't misreported as uncovered
+    spec_q = max((int(k[1]) for k in occ
+                  if len(k) > 4 and k[4] == "spec"), default=0)
     current = set(lattice_keys(
         max_prompt=max(prompt_lens), max_new_tokens=max(
             max(int(r["gen_len"]) for r in requests), 1),
         max_concurrency=mc, page_size=page,
         max_ragged_batch_size=batch_size, has_fresh=True,
-        sampling=True))
+        sampling=True, spec_max_draft=max(spec_q - 1, 0)))
     uncovered = sorted(k for k in occ if k not in current)
 
     # -- recommended lattice ------------------------------------------
@@ -158,6 +185,26 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
     # (e.g. dropping a rare-key tail) surfaces any regression here
     recommended_keys = sorted(occ)
     rec_uncovered = sorted(set(compile_keys) - set(recommended_keys))
+
+    # -- speculation mining (ISSUE 10): accept rates recorded per
+    # request recommend the verify width for this workload ------------
+    drafted = sum(int(r.get("spec_drafted", 0)) for r in requests)
+    accepted = sum(int(r.get("spec_accepted", 0)) for r in requests)
+    accept_rate = (accepted / drafted) if drafted else None
+    speculation = {
+        "drafted": drafted,
+        "accepted": accepted,
+        "accept_rate": (round(accept_rate, 4)
+                        if accept_rate is not None else None),
+        "recommended_spec_max_draft": (
+            recommend_spec_max_draft(accept_rate)
+            if accept_rate is not None else None),
+        "note": (None if drafted else
+                 "no speculation in this trace — capture with "
+                 "serving_optimization.speculative=true (or replay "
+                 "with tools/replay_trace.py --spec) to mine accept "
+                 "rates"),
+    }
 
     return {
         "meta": {k: v for k, v in meta.items() if k != "kind"},
@@ -190,6 +237,7 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
             "observed_keys": len(occ),
             "uncovered_by_current": [list(k) for k in uncovered],
         },
+        "speculation": speculation,
         "recommended_lattice": {
             "page_size": page,
             "s_buckets": s_buckets,

@@ -111,6 +111,26 @@ def compare(prev: Dict, cur: Dict) -> List[Tuple[str, str]]:
     return findings
 
 
+def spec_findings(cur: Dict) -> List[str]:
+    """In-round speculative-decoding gate (ISSUE 10): on the
+    HIGH-repetition workload the spec leg exists to be faster — warn
+    when it measured slower than the spec-off leg of the same round.
+    The low-repetition leg is exempt: there speculation is expected to
+    roughly break even (graceful degradation), not win."""
+    on = cur.get("fastgen_spec_decode_tok_s")
+    off = cur.get("fastgen_spec_off_decode_tok_s")
+    if not (isinstance(on, (int, float)) and isinstance(off, (int, float))
+            and off > 0):
+        return []
+    if on < off:
+        rate = cur.get("fastgen_spec_accept_rate")
+        return [f"speculative decoding is SLOWER than spec-off on the "
+                f"high-repetition leg ({on} vs {off} tok/s, accept rate "
+                f"{rate}) — check the drafter/accept path before "
+                f"enabling serving_optimization.speculative"]
+    return []
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--dir", default=REPO_ROOT,
@@ -144,6 +164,7 @@ def main(argv=None) -> int:
         return 0
 
     findings = compare(prev, cur)
+    findings += [("note", m) for m in spec_findings(cur)]
     regressions = [m for sev, m in findings if sev == "regression"]
     notes = [m for sev, m in findings if sev == "note"]
     label = (f"{os.path.basename(prev_path)} -> "

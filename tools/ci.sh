@@ -9,7 +9,11 @@
 #   3. replay smoke  — tools/replay_trace.py --check over the first 32
 #                      requests of the checked-in sample trace: a
 #                      captured workload must replay with matching
-#                      request count / lengths / share structure
+#                      request count / lengths / share structure; the
+#                      --spec pass replays the same workload with
+#                      speculative decoding on and checks the SAME
+#                      structural parity (speculation may change only
+#                      throughput/metrics, ISSUE 10)
 #   4. metric lint   — tools/check_metrics.py (naming convention +
 #                      DESIGN.md documentation + no dead metrics for
 #                      every ds_* metric)
@@ -36,9 +40,9 @@ timeout -k 10 "$TIMEOUT" python -m pytest tests/ -q -m 'not slow' \
 echo "== chaos tier =="
 python -m pytest tests/ -q -m chaos -p no:cacheprovider
 
-echo "== workload replay smoke =="
+echo "== workload replay smoke (incl. speculative pass) =="
 python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
-    --limit 32 --check > /dev/null
+    --limit 32 --spec --check > /dev/null
 
 echo "== metric namespace lint =="
 python tools/check_metrics.py

@@ -242,9 +242,18 @@ def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
     first_t: Dict[int, float] = {}
     gen: Dict[int, int] = {}
     submitted: List[int] = []
-    done_tokens = 0
+    token_count = [0]
     nxt = 0
     stalls = 0
+
+    def on_token(uid: int, _tok: int) -> None:
+        # per-token accounting MUST ride the callback: a speculative
+        # step commits a whole accepted block per row per step, so the
+        # step() return dict (one entry per uid) undercounts
+        token_count[0] += 1
+        gen[uid] = gen.get(uid, 0) + 1
+        first_t.setdefault(uid, time.perf_counter())
+
     t0 = time.perf_counter()
     while nxt < len(order) or sched.has_work:
         now = time.perf_counter()
@@ -260,8 +269,7 @@ def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
                 submitted.append(i)
             nxt += 1
         if sched.has_work:
-            out = sched.step()
-            now = time.perf_counter()
+            out = sched.step(on_token=on_token)
             stalls = (stalls + 1 if sched.last_step_scheduled == 0
                       and not out else 0)
             if stalls > 64:
@@ -269,10 +277,6 @@ def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
                     "replay stalled: requests unschedulable (trace "
                     "needs a larger KV pool / context than the replay "
                     "engine has)")
-            for uid, _tok in out.items():
-                done_tokens += 1
-                gen[uid] = gen.get(uid, 0) + 1
-                first_t.setdefault(uid, now)
         elif nxt < len(order):
             if speed > 0:
                 gap = (float(requests[order[nxt]].get("arrival_s", 0.0))
@@ -288,11 +292,14 @@ def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
         "gen_lens": {i: gen.get(i, 0) for i in submitted},
         "errors": {int(u): e.code for u, e in sched.errors.items()},
         "wall_s": round(total, 4),
-        "decode_tok_s": round(done_tokens / total, 1) if total else None,
+        "decode_tok_s": (round(token_count[0] / total, 1) if total
+                         else None),
         "ttft_p50_ms": percentile(ttfts, 50),
         "ttft_p99_ms": percentile(ttfts, 99),
         "step_cache_miss": tm.FASTGEN_STEP_CACHE_MISS.value - miss0,
         "compile_on_path": tm.FASTGEN_COMPILE_ON_PATH.value - comp0,
+        "spec_drafted": sched._spec_drafted_cum,
+        "spec_accepted": sched._spec_accepted_cum,
     }
 
 
@@ -357,15 +364,28 @@ def diff_replay(requests: List[Dict[str, Any]],
             "recorded_queue_wait_p50_ms": rec_pct["queue_wait_p50_ms"]}
 
 
+def _reset_engine(engine) -> None:
+    """Flush every tracked sequence and drop the prefix cache so the
+    next replay pass starts from cold engine state."""
+    for uid in list(engine.state_manager._seqs):
+        engine.flush(uid)
+    engine.reset_prefix_cache()
+
+
 def run_replay(trace_path: str, limit: int = 0,
                include_errors: bool = False, speed: float = 0.0,
                model_size: str = "debug", seed: int = 0,
                warmup: bool = True,
-               tolerance: float = 4.0) -> Dict[str, Any]:
+               tolerance: float = 4.0,
+               spec: bool = False) -> Dict[str, Any]:
     """The one load → filter → build → synthesize → (shape-warmup) →
     measured-replay → diff sequence, shared by the CLI, the CI smoke,
     and bench.py's BENCH_REPLAY leg — so the three can't drift on the
-    warmup convention or the vocab clamp."""
+    warmup convention or the vocab clamp.  With ``spec`` the same
+    workload is replayed a second time with speculative decoding
+    enabled and the report gains a ``spec`` block: accept rate, tok/s
+    on/off, and the spec pass's own structural-parity diff (ISSUE 10 —
+    speculation must change throughput and metrics, nothing else)."""
     trace = load_trace(trace_path)
     requests = trace["requests"]
     if not include_errors:
@@ -385,16 +405,41 @@ def run_replay(trace_path: str, limit: int = 0,
         # untimed shape warmup (the bench convention): the measured
         # replay then shows REAL on-path recompiles, not cold-start
         replay(engine, requests, prompts, speed=0.0)
-        for uid in list(engine.state_manager._seqs):
-            engine.flush(uid)
-        engine.reset_prefix_cache()
+        _reset_engine(engine)
     report = replay(engine, requests, prompts, speed=speed)
     verdict = diff_replay(requests, prompts, page, report,
                           tolerance=tolerance)
-    return {"trace": trace_path, "meta": meta,
-            "requests": len(requests),
-            "recorded_compiles": len(trace["compiles"]),
-            "replay": report, "diff": verdict}
+    out = {"trace": trace_path, "meta": meta,
+           "requests": len(requests),
+           "recorded_compiles": len(trace["compiles"]),
+           "replay": report, "diff": verdict}
+    if spec:
+        from deepspeed_tpu.inference.v2 import ServingOptimizationConfig
+        spec_serving = ServingOptimizationConfig(speculative=True)
+        if warmup:
+            _reset_engine(engine)
+            replay(engine, requests, prompts, speed=0.0,
+                   serving=spec_serving)
+        _reset_engine(engine)
+        spec_report = replay(engine, requests, prompts, speed=speed,
+                             serving=spec_serving)
+        spec_diff = diff_replay(requests, prompts, page, spec_report,
+                                tolerance=tolerance)
+        drafted = spec_report["spec_drafted"]
+        off_tok_s = report["decode_tok_s"]
+        out["spec"] = {
+            "replay": spec_report, "diff": spec_diff,
+            "accept_rate": (round(spec_report["spec_accepted"] / drafted,
+                                  4) if drafted else None),
+            "drafted": drafted,
+            "accepted": spec_report["spec_accepted"],
+            "tok_s_off": off_tok_s,
+            "tok_s_on": spec_report["decode_tok_s"],
+            "tok_s_ratio": (round(spec_report["decode_tok_s"]
+                                  / off_tok_s, 3)
+                            if off_tok_s else None),
+        }
+    return out
 
 
 # -- CLI ---------------------------------------------------------------------
@@ -415,6 +460,10 @@ def main(argv=None) -> int:
     ap.add_argument("--include-errors", action="store_true",
                     help="also replay requests whose recorded outcome "
                     "was a structured error (default: ok only)")
+    ap.add_argument("--spec", action="store_true",
+                    help="replay a second pass with speculative "
+                    "decoding enabled and report accept rate + tok/s "
+                    "delta (ISSUE 10)")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the untimed shape-warmup pass (the "
                     "measured run then eats the XLA compiles)")
@@ -430,7 +479,7 @@ def main(argv=None) -> int:
                          include_errors=args.include_errors,
                          speed=args.speed, model_size=args.model_size,
                          seed=args.seed, warmup=not args.no_warmup,
-                         tolerance=args.tolerance)
+                         tolerance=args.tolerance, spec=args.spec)
     except ValueError as e:
         print(f"replay_trace: {e}", file=sys.stderr)
         return 1
@@ -439,9 +488,16 @@ def main(argv=None) -> int:
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=1, default=str)
-    if args.check and not verdict["structural_ok"]:
+    problems = list(verdict["problems"]) if not verdict["structural_ok"] \
+        else []
+    if args.spec and not out["spec"]["diff"]["structural_ok"]:
+        # the spec pass must reproduce the same structure — speculation
+        # may only change throughput/metrics
+        problems += [f"[spec] {p}"
+                     for p in out["spec"]["diff"]["problems"]]
+    if args.check and problems:
         print("replay_trace: STRUCTURAL PARITY FAILED", file=sys.stderr)
-        for p in verdict["problems"]:
+        for p in problems:
             print(f"replay_trace:   {p}", file=sys.stderr)
         return 1
     return 0
