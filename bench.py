@@ -339,15 +339,6 @@ def bench_fastgen(jax):
             try:
                 from deepspeed_tpu import telemetry
                 from deepspeed_tpu.telemetry import metrics as tmet
-                for h in (tmet.FASTGEN_TTFT_MS, tmet.FASTGEN_ITL_MS,
-                          tmet.FASTGEN_QUEUE_WAIT_MS, tmet.FASTGEN_STEP_MS):
-                    h.reset()
-                # recompile accounting (ISSUE 5): the warmups above
-                # compiled every bucket this workload hits, so misses in
-                # the measured window ARE on-request-path recompiles —
-                # the bench trajectory should show 0 and flag drift
-                tmet.FASTGEN_STEP_CACHE_MISS.reset()
-                tmet.FASTGEN_COMPILE_ON_PATH.reset()
                 telemetry.get_tracer().clear()
                 # the prefix leg may have bound the ds_kv_* gauges to
                 # its dedicated engine — rebind to the measured one
@@ -355,24 +346,57 @@ def bench_fastgen(jax):
                 # cost/MFU window (ISSUE 9): re-open at the measured
                 # run so the warmups' dispatches don't dilute the rate
                 eng.model.reset_cost_window()
+                # measured-window reads come from the time-series ring
+                # (ISSUE 11): bracketing samples make the run ITS OWN
+                # delta window, so the cumulative SLO histograms and
+                # miss counters need no reset-after-warmup dance — the
+                # warmups' observations simply fall outside the window
+                ts = telemetry.get_timeseries()
+                # retention must outlast the slowest CI run of this
+                # leg, or the bracketing s_before sample gets evicted
+                # and the "measured window" silently becomes the tail
+                ts.configure(interval_s=0.25, retention_s=1800)
                 was_enabled = telemetry.enabled()
                 telemetry.enable()
+                s_before = ts.sample_now()
                 try:
-                    run(range(n_req), serving=main_serving)
+                    slo_total, _, slo_tokens = run(range(n_req),
+                                                   serving=main_serving)
                 finally:
                     telemetry.set_enabled(was_enabled)
+                s_after = ts.sample_now()
+                want_window = s_after["t"] - s_before["t"] + 1e-6
+                win = ts.window_snapshot(want_window)
+                if win["_window_covered_s"] < 0.98 * (want_window - 1e-6):
+                    # ring evicted s_before: the values below cover
+                    # only the tail — flag it instead of lying
+                    result["fastgen_window_truncated_s"] = round(
+                        want_window - win["_window_covered_s"], 1)
                 result["fastgen_ttft_p99_ms"] = round(
-                    tmet.FASTGEN_TTFT_MS.percentile(99), 1)
+                    win["ds_fastgen_ttft_ms_p99"], 1)
                 result["fastgen_itl_p50_ms"] = round(
-                    tmet.FASTGEN_ITL_MS.percentile(50), 2)
+                    win["ds_fastgen_itl_ms_p50"], 2)
                 result["fastgen_queue_wait_p50_ms"] = round(
-                    tmet.FASTGEN_QUEUE_WAIT_MS.percentile(50), 1)
+                    win["ds_fastgen_queue_wait_ms_p50"], 1)
                 result["fastgen_step_p99_ms"] = round(
-                    tmet.FASTGEN_STEP_MS.percentile(99), 2)
+                    win["ds_fastgen_step_ms_p99"], 2)
+                # recompile accounting (ISSUE 5): the warmups above
+                # compiled every bucket this workload hits, so misses
+                # IN THE WINDOW are real on-request-path recompiles —
+                # the bench trajectory should show 0 and flag drift
                 result["fastgen_step_cache_miss_total"] = \
-                    tmet.FASTGEN_STEP_CACHE_MISS.value
+                    win["ds_fastgen_step_cache_miss_total"]
                 result["fastgen_compile_on_path_total"] = \
-                    tmet.FASTGEN_COMPILE_ON_PATH.value
+                    win["ds_fastgen_compile_on_path_total"]
+                # windowed-rate cross-check (ISSUE 11 acceptance): the
+                # ring's tok/s over the measured window vs the
+                # bench-computed throughput of the same run (~1.0)
+                win_tok_s = win.get("ds_fastgen_tokens_total_per_s")
+                if win_tok_s and slo_total:
+                    bench_tok_s = slo_tokens / slo_total
+                    result["fastgen_window_tok_s"] = round(win_tok_s, 1)
+                    result["fastgen_window_rate_agreement"] = round(
+                        win_tok_s / bench_tok_s, 4)
                 # hardware denominator (ISSUE 9): dispatched-program
                 # FLOPs / wall / peak over the measured window (read
                 # IMMEDIATELY — the gauge is wall-relative and decays
@@ -656,6 +680,25 @@ def bench_fastgen(jax):
                 sys.stderr.write(f"bench: fastgen replay leg failed: "
                                  f"{e}\n")
                 result["fastgen_replay_error"] = str(e)[:300]
+        if os.environ.get("BENCH_FLEET", "0") != "0":
+            # fleet leg (ISSUE 11): two live replica subprocesses
+            # replay a synthetic workload; one is killed mid-replay
+            # through the serving.preempt chaos site while the parent
+            # federates both /snapshot endpoints, samples a fleet
+            # time-series ring, and runs the SLO burn-rate evaluator
+            # over it.  Emits aggregate tok/s and merged p99 TTFT
+            # ACROSS the kill event plus the page/advice facts — the
+            # ROADMAP item 1 controller's input signals, measured.
+            # Off by default (spawns two engines); own try.
+            try:
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                from tools.fleetctl import run_kill_demo
+                result.update(run_kill_demo())
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: fastgen fleet leg failed: "
+                                 f"{e}\n")
+                result["fastgen_fleet_error"] = str(e)[:300]
         return result
     except Exception as e:  # noqa: BLE001 — aux leg must not kill the bench
         sys.stderr.write(f"bench: fastgen leg failed: {e}\n")

@@ -104,9 +104,12 @@ class TelemetryConfig:
     (``DS_TELEMETRY`` / ``telemetry.enable()``); ``metrics_port``
     starts the Prometheus endpoint (0 = off); ``trace_buffer`` resizes
     the span ring (0 = keep current capacity).  ISSUE 5 watchdog /
-    flight-recorder knobs and the ISSUE 9 workload-trace knobs
-    (``workload_trace_path`` / ``workload_trace_max_mb``) follow the
-    same keep-current convention (see the runtime config's
+    flight-recorder knobs, the ISSUE 9 workload-trace knobs
+    (``workload_trace_path`` / ``workload_trace_max_mb``), and the
+    ISSUE 11 fleet-observatory knobs (``timeseries_interval_s`` /
+    ``timeseries_retention_s`` / ``fleet_targets`` /
+    ``slo_objectives``; ``metrics_port=-1`` = ephemeral port) follow
+    the same keep-current convention (see the runtime config's
     ``TelemetryConfig`` for semantics)."""
     enabled: Optional[bool] = None
     metrics_port: int = 0
@@ -118,6 +121,10 @@ class TelemetryConfig:
     flight_recorder_events: int = 0
     workload_trace_path: str = ""
     workload_trace_max_mb: int = 0
+    timeseries_interval_s: float = 0.0
+    timeseries_retention_s: float = 0.0
+    fleet_targets: str = ""
+    slo_objectives: list = dataclasses.field(default_factory=list)
 
     def apply(self) -> None:
         from ...telemetry import apply_settings
@@ -128,7 +135,11 @@ class TelemetryConfig:
                        postmortem_dir=self.postmortem_dir,
                        flight_recorder_events=self.flight_recorder_events,
                        workload_trace_path=self.workload_trace_path,
-                       workload_trace_max_mb=self.workload_trace_max_mb)
+                       workload_trace_max_mb=self.workload_trace_max_mb,
+                       timeseries_interval_s=self.timeseries_interval_s,
+                       timeseries_retention_s=self.timeseries_retention_s,
+                       fleet_targets=self.fleet_targets,
+                       slo_objectives=self.slo_objectives)
 
 
 @dataclasses.dataclass

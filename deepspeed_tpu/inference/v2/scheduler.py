@@ -52,6 +52,7 @@ from ...telemetry import get_tracer, trace_span
 from ...telemetry import metrics as tm
 from ...telemetry.flight_recorder import get_flight_recorder
 from ...telemetry.state import state as _telemetry
+from ...telemetry.timeseries import get_timeseries
 from ...telemetry.watchdog import get_watchdog
 from ...telemetry.workload_trace import get_workload_trace
 from ...utils.comms_logging import serving_counters
@@ -252,6 +253,10 @@ class FastGenScheduler:
         #: ``active`` attribute is the whole disabled-path cost of every
         #: capture hook below
         self._wtrace = get_workload_trace()
+        #: fleet observatory (ISSUE 11): the time-series ring ticks on
+        #: the step path (same ``active`` one-attribute-read contract),
+        #: so a serving process samples without a background thread
+        self._tseries = get_timeseries()
         self._bind_backlog_gauges()
         # -- speculative decoding (ISSUE 10) --------------------------
         self._spec_cfg = bool(getattr(sv, "speculative", False))
@@ -565,6 +570,10 @@ class FastGenScheduler:
         token terminates the request (max_new_tokens reached or stop
         token hit); the caller then runs :meth:`_finish_request`."""
         req.generated.append(tok)
+        # unconditional (the ServingCounters convention): the windowed
+        # tok/s the fleet view and SLO evaluator read must exist even
+        # telemetry-off — one integer add per token
+        tm.FASTGEN_TOKENS.inc()
         if _telemetry.enabled:
             self._note_token_slo(req)
         if self._wtrace.active:
@@ -894,6 +903,11 @@ class FastGenScheduler:
             raise
         if self._kv_debug:
             self._engine.state_manager.check_invariants()
+        if self._tseries.active:
+            # opportunistic time-series tick (ISSUE 11): interval-gated
+            # inside, so a fast step loop samples at the configured
+            # cadence, not per step
+            self._tseries.maybe_sample()
         return out
 
     def _match_prefix_once(self, req: Request, adm: _Admission) -> None:

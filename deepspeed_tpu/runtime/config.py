@@ -244,7 +244,17 @@ class TelemetryConfig(DeepSpeedConfigModel):
     Workload observatory (ISSUE 9): ``workload_trace_path`` opens the
     content-free per-request JSONL ledger ("" = keep, same as
     ``DS_WORKLOAD_TRACE``); ``workload_trace_max_mb`` bounds one
-    rotation generation (0 = keep, default 32)."""
+    rotation generation (0 = keep, default 32).
+
+    Fleet observatory (ISSUE 11): ``metrics_port`` of -1 binds an
+    EPHEMERAL port (``DS_METRICS_PORT=0`` semantics — the bound port
+    lands in the ``ds_telemetry_port`` gauge); ``timeseries_interval_s``
+    / ``timeseries_retention_s`` start the bounded time-series sampler
+    (0 = keep/off, same as ``DS_TIMESERIES``); ``fleet_targets`` is a
+    comma-separated ``[label=]host:port`` replica list for the
+    ``/fleet`` federation ("" = keep, same as ``DS_FLEET_TARGETS``);
+    ``slo_objectives`` is a list of burn-rate objective dicts (see
+    ``telemetry/slo.py``; empty = keep)."""
     enabled: Optional[bool] = None
     metrics_port: int = 0
     trace_buffer: int = 0
@@ -255,6 +265,10 @@ class TelemetryConfig(DeepSpeedConfigModel):
     flight_recorder_events: int = 0
     workload_trace_path: str = ""
     workload_trace_max_mb: int = 0
+    timeseries_interval_s: float = 0.0
+    timeseries_retention_s: float = 0.0
+    fleet_targets: str = ""
+    slo_objectives: List[Dict[str, Any]] = Field(default_factory=list)
 
     def apply(self) -> None:
         """Push this block into the process-wide telemetry state (shared
@@ -267,7 +281,11 @@ class TelemetryConfig(DeepSpeedConfigModel):
                        postmortem_dir=self.postmortem_dir,
                        flight_recorder_events=self.flight_recorder_events,
                        workload_trace_path=self.workload_trace_path,
-                       workload_trace_max_mb=self.workload_trace_max_mb)
+                       workload_trace_max_mb=self.workload_trace_max_mb,
+                       timeseries_interval_s=self.timeseries_interval_s,
+                       timeseries_retention_s=self.timeseries_retention_s,
+                       fleet_targets=self.fleet_targets,
+                       slo_objectives=self.slo_objectives)
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

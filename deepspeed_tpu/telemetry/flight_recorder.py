@@ -14,7 +14,13 @@ preemptions / evictions, watchdog verdicts, checkpoint save/load) plus
 plus, when workload capture is enabled (ISSUE 9), a sixth artifact:
 
 - ``workload.jsonl`` — the tail of the live workload-trace ledger, so
-  a crash ships the traffic that caused it alongside the forensics.
+  a crash ships the traffic that caused it alongside the forensics,
+
+and, when the time-series sampler is running (ISSUE 11), a seventh:
+
+- ``timeseries.json`` — the sampled metric ring: the minutes BEFORE
+  the crash (rates, trends, windowed histogram states), not just the
+  final instant.
 
 Invoked automatically when an unhandled exception escapes
 ``train_batch`` or the FastGen step loop (once per process, into the
@@ -171,6 +177,17 @@ class FlightRecorder:
             with open(path, "w") as f:
                 f.write(tail)
             paths["workload.jsonl"] = path
+        # seventh artifact (ISSUE 11): the time-series ring — only when
+        # the sampler is configured and has samples, so forensics get
+        # the minutes BEFORE the crash (windowed rates, gauge
+        # trajectories, delta-able histogram states), not just the
+        # instant of it
+        from .timeseries import get_timeseries
+        tsr = get_timeseries()
+        if tsr.active:
+            doc = tsr.to_json()
+            if doc["samples"]:
+                write("timeseries.json", doc)
         return paths
 
     # -- automatic invocation paths ------------------------------------------

@@ -6,7 +6,8 @@ one place to drift from — and ``tools/check_metrics.py`` lints this
 registry against docs/DESIGN.md's metric table in tier-1.
 
 Naming convention: ``ds_<area>_<name>`` with area one of
-{serving, comm, kv, train, fastgen, chaos}; counters end in ``_total``.
+{serving, comm, kv, train, fastgen, chaos, fleet, slo, telemetry};
+counters end in ``_total``.
 """
 
 from __future__ import annotations
@@ -195,6 +196,37 @@ FASTGEN_SPEC_ACCEPTED = registry.counter(
 FASTGEN_SPEC_ACCEPT_RATE = registry.gauge(
     "ds_fastgen_spec_accept_rate",
     "cumulative accepted/drafted ratio of speculative decoding")
+
+# -- fleet observatory (ISSUE 11) --------------------------------------------
+FASTGEN_TOKENS = registry.counter(
+    "ds_fastgen_tokens_total",
+    "committed tokens delivered host-side across all requests (the "
+    "windowed tok/s numerator; counted even telemetry-off, like "
+    "ServingCounters)")
+TELEMETRY_PORT = registry.gauge(
+    "ds_telemetry_port",
+    "TCP port the local metrics endpoint actually bound (ephemeral "
+    "under DS_METRICS_PORT=0 — federation discovers replicas by it)")
+FLEET_REPLICAS_LIVE = registry.gauge(
+    "ds_fleet_replicas_live",
+    "federation replicas answering scrapes within the staleness bound")
+FLEET_REPLICAS_STALE = registry.gauge(
+    "ds_fleet_replicas_stale",
+    "federation replicas whose last successful scrape is stale (their "
+    "last-good snapshot stays in the merge)")
+SLO_STATUS = registry.gauge(
+    "ds_slo_status",
+    "worst current SLO verdict across objectives (0 ok, 1 warn, "
+    "2 page)")
+SLO_WORST_BURN = registry.gauge(
+    "ds_slo_worst_fast_burn",
+    "highest fast-window burn rate across configured objectives")
+SLO_PAGES = registry.counter(
+    "ds_slo_pages_total",
+    "SLO objective transitions into the page verdict")
+SLO_WARNS = registry.counter(
+    "ds_slo_warns_total",
+    "SLO objective transitions into the warn verdict (from ok)")
 
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(

@@ -38,6 +38,32 @@ def log_buckets(lo: float, hi: float, ratio: float = 2 ** 0.25
 DEFAULT_MS_BUCKETS = log_buckets(1e-2, 6e5)
 
 
+def percentile_from_counts(bounds: Sequence[float],
+                           counts: Sequence[int], count: int,
+                           q: float) -> float:
+    """Approximate q-th percentile (q in [0, 100]) by linear
+    interpolation inside the bucket where the cumulative count crosses
+    rank q/100 * count.  The ONE percentile implementation shared by
+    live histograms, the time-series ring's delta-windowed views, and
+    the fleet federation's merged histograms — merged-then-percentile
+    is bit-equal to observe-all-then-percentile exactly because all
+    three run this same arithmetic over summed integer counts."""
+    if count == 0:
+        return 0.0
+    target = (q / 100.0) * count
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= target:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = (bounds[i] if i < len(bounds) else bounds[-1])
+            frac = (target - cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+    return bounds[-1]
+
+
 class Counter:
     """Monotonic counter (resettable for measured windows)."""
     __slots__ = ("name", "help", "value")
@@ -121,24 +147,10 @@ class Histogram:
         self.sum += v
 
     def percentile(self, q: float) -> float:
-        """Approximate q-th percentile (q in [0, 100]) by linear
-        interpolation inside the bucket where the cumulative count
-        crosses rank q/100 * count."""
-        if self.count == 0:
-            return 0.0
-        target = (q / 100.0) * self.count
-        cum = 0.0
-        for i, c in enumerate(self.counts):
-            if c == 0:
-                continue
-            if cum + c >= target:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = (self.bounds[i] if i < len(self.bounds)
-                      else self.bounds[-1])
-                frac = (target - cum) / c
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-            cum += c
-        return self.bounds[-1]
+        """Approximate q-th percentile (q in [0, 100]); see
+        :func:`percentile_from_counts`."""
+        return percentile_from_counts(self.bounds, self.counts,
+                                      self.count, q)
 
     @property
     def mean(self) -> float:
@@ -217,6 +229,32 @@ class MetricsRegistry:
                 out[f"{name}_mean"] = m.mean
             else:
                 out[name] = m.value
+        return out
+
+    def raw_snapshot(self) -> Dict[str, Dict]:
+        """Structured snapshot preserving histogram BUCKET COUNTS (the
+        flat :meth:`snapshot` collapses them to percentiles, which
+        cannot be merged across replicas).  This is the substrate the
+        time-series sampler rings and the fleet federation merges:
+        counters/gauges by value, histograms as
+        ``{"bounds", "counts", "count", "sum"}``.  Gauges appear only
+        once touched (bound or ever set) — an untouched gauge would
+        pollute a fleet min/max rollup with a meaningless 0."""
+        out: Dict[str, Dict] = {"counters": {}, "gauges": {},
+                                "hists": {}}
+        for name, m in self.all_metrics().items():
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                if m.touched:
+                    out["gauges"][name] = m.value
+            else:
+                out["hists"][name] = {
+                    "bounds": list(m.bounds),
+                    "counts": list(m.counts),
+                    "count": m.count,
+                    "sum": m.sum,
+                }
         return out
 
     def prometheus_text(self) -> str:

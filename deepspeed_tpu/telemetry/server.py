@@ -4,14 +4,27 @@ Off by default; enabled by ``DS_METRICS_PORT=<port>`` (or the runtime
 config's ``telemetry.metrics_port``).  Serves:
 
 - ``/metrics``  — Prometheus text exposition of the registry
-- ``/snapshot`` — the registry's flat JSON snapshot
+- ``/snapshot`` — the registry's flat JSON snapshot;
+  ``?window=<seconds>`` returns delta-windowed values from the
+  time-series ring (ISSUE 11) instead of lifetime cumulatives;
+  ``?raw=1`` returns the structured raw snapshot with histogram bucket
+  counts — the body the fleet federation merges exactly
+- ``/fleet``    — the federation's merged ``ds_fleet_*`` view over the
+  configured replica targets (text; ``?json=1`` for JSON)
 - ``/trace``    — current span ring buffer as Chrome-trace JSON
-- ``/healthz``  — watchdog verdicts + uptime (ISSUE 5); HTTP 200 while
-  healthy, 503 on a non-finite or anomaly-storm verdict so a fleet
-  health checker needs no JSON parsing
+- ``/healthz``  — watchdog verdicts + SLO burn-rate verdicts + uptime;
+  HTTP 200 while healthy, 503 on a non-finite / anomaly-storm /
+  SLO-page verdict so a fleet health checker needs no JSON parsing
 
-Binds ``DS_METRICS_ADDR`` (default 127.0.0.1).  Port 0 picks an
-ephemeral port (tests); the bound port is on the returned server.
+Binds ``DS_METRICS_ADDR`` (default 127.0.0.1).  ``DS_METRICS_PORT=0``
+binds an EPHEMERAL port (two replicas on one host cannot collide); the
+bound port is on the returned server handle, in a log line, and in the
+``ds_telemetry_port`` gauge so federation can discover it.  Unset =
+off (the seed semantics for "no value").
+
+:func:`serve_registry` starts ADDITIONAL servers bound to explicit
+registries (same-process replica pools, federation tests) — the
+module-level singleton stays the process's own endpoint.
 """
 
 from __future__ import annotations
@@ -19,6 +32,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -30,14 +44,25 @@ _lock = threading.Lock()
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
+    def _registry(self):
+        return getattr(self.server, "ds_registry", None) or get_registry()
+
     def do_GET(self):  # noqa: N802 — http.server API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
+        params = urllib.parse.parse_qs(query)
         if path in ("/metrics", "/"):
-            body = get_registry().prometheus_text().encode()
+            body = self._registry().prometheus_text().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/snapshot":
-            body = json.dumps(get_registry().snapshot()).encode()
+            doc, err = self._snapshot_doc(params)
+            if err is not None:
+                self.send_error(400, err)
+                return
+            body = json.dumps(doc).encode()
             ctype = "application/json"
+        elif path == "/fleet":
+            self._do_fleet(params)
+            return
         elif path == "/trace":
             body = json.dumps({
                 "traceEvents": get_tracer().chrome_events(),
@@ -45,11 +70,14 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             ctype = "application/json"
         elif path == "/healthz":
             from .watchdog import get_watchdog
+            from .slo import get_slo_evaluator
             health = get_watchdog().health()
+            slo = get_slo_evaluator().current()
+            health["slo"] = slo
+            ok = health["status"] == "ok" and slo["status"] != "page"
             body = json.dumps(health).encode()
-            ctype = "application/json"
-            self.send_response(200 if health["status"] == "ok" else 503)
-            self.send_header("Content-Type", ctype)
+            self.send_response(200 if ok else 503)
+            self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -63,13 +91,78 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _snapshot_doc(self, params):
+        """(/snapshot body, error) honoring ``window`` and ``raw``."""
+        if "window" in params:
+            try:
+                window_s = float(params["window"][0])
+            except (ValueError, IndexError):
+                return None, "window must be a number of seconds"
+            if window_s <= 0:
+                return None, "window must be > 0"
+            ts = getattr(self.server, "ds_timeseries", None)
+            if ts is None:
+                if getattr(self.server, "ds_registry", None) is not None:
+                    # an extra serve_registry() server without its own
+                    # ring: falling back to the process-global ring
+                    # would serve windowed data for a DIFFERENT
+                    # registry than this port's other endpoints
+                    return None, ("this endpoint has no time-series "
+                                  "ring bound; pass timeseries= to "
+                                  "serve_registry for windowed "
+                                  "snapshots")
+                from .timeseries import get_timeseries
+                ts = get_timeseries()
+            if not ts.active:
+                # ASCII only: http.server encodes the status line as
+                # latin-1
+                return None, ("time-series sampling is off; configure "
+                              "telemetry.timeseries_interval_s / "
+                              "DS_TIMESERIES for windowed snapshots")
+            return ts.window_snapshot(window_s), None
+        if params.get("raw", ["0"])[0] not in ("", "0"):
+            return self._registry().raw_snapshot(), None
+        return self._registry().snapshot(), None
+
+    def _do_fleet(self, params) -> None:
+        fed = getattr(self.server, "ds_federation", None)
+        if fed is None:
+            from .federation import get_federation
+            fed = get_federation()
+        if not fed.labels():
+            self.send_error(
+                404, "no fleet targets configured (telemetry."
+                "fleet_targets / DS_FLEET_TARGETS)")
+            return
+        if params.get("json", ["0"])[0] not in ("", "0"):
+            body = json.dumps(fed.snapshot_json()).encode()
+            ctype = "application/json"
+        else:
+            body = fed.prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, fmt, *args):  # quiet: no per-scrape stderr spam
         pass
 
 
+def _spawn(srv: ThreadingHTTPServer, name: str) -> None:
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, name=name,
+                         daemon=True)
+    t.start()
+
+
 def start_http_server(port: int,
                       addr: Optional[str] = None) -> ThreadingHTTPServer:
-    """Start (or return the already-running) metrics server."""
+    """Start (or return the already-running) process metrics server.
+    Port 0 binds an ephemeral port; the bound port is on the returned
+    handle (``server_address[1]``), logged, and published as the
+    ``ds_telemetry_port`` gauge for federation discovery."""
     global _server
     with _lock:
         if _server is not None:
@@ -84,12 +177,36 @@ def start_http_server(port: int,
         addr = addr if addr is not None else os.environ.get(
             "DS_METRICS_ADDR", "127.0.0.1")
         srv = ThreadingHTTPServer((addr, int(port)), _MetricsHandler)
-        srv.daemon_threads = True
-        t = threading.Thread(target=srv.serve_forever,
-                             name="ds-metrics-http", daemon=True)
-        t.start()
+        _spawn(srv, "ds-metrics-http")
         _server = srv
-        return srv
+    bound = srv.server_address[1]
+    from . import metrics as tm
+    tm.TELEMETRY_PORT.set(bound)
+    from ..utils.logging import logger
+    logger.info("telemetry: metrics endpoint on %s:%d "
+                "(/metrics /snapshot /fleet /trace /healthz)",
+                addr, bound)
+    return srv
+
+
+def serve_registry(registry, port: int = 0, addr: Optional[str] = None,
+                   timeseries=None,
+                   federation=None) -> ThreadingHTTPServer:
+    """Start an ADDITIONAL server bound to an explicit registry (and
+    optionally its own time-series ring / federation) — same-process
+    replica pools and federation tests.  The caller owns shutdown
+    (``srv.shutdown(); srv.server_close()``); the process singleton is
+    untouched."""
+    addr = addr if addr is not None else os.environ.get(
+        "DS_METRICS_ADDR", "127.0.0.1")
+    srv = ThreadingHTTPServer((addr, int(port)), _MetricsHandler)
+    srv.ds_registry = registry
+    if timeseries is not None:
+        srv.ds_timeseries = timeseries
+    if federation is not None:
+        srv.ds_federation = federation
+    _spawn(srv, "ds-metrics-http-extra")
+    return srv
 
 
 def stop_http_server() -> None:
@@ -99,15 +216,27 @@ def stop_http_server() -> None:
             _server.shutdown()
             _server.server_close()
             _server = None
+            # keep the discovery signal truthful: a federation reading
+            # ds_telemetry_port must not connect to the dead port
+            from . import metrics as tm
+            tm.TELEMETRY_PORT.set(0)
+
+
+def bound_port() -> int:
+    """The process endpoint's bound port, 0 when not running."""
+    with _lock:
+        return _server.server_address[1] if _server is not None else 0
 
 
 def maybe_start_from_env() -> Optional[ThreadingHTTPServer]:
-    """Honor ``DS_METRICS_PORT`` (off when unset/0).  Bind failures
-    degrade to a warning, never an import error: in a multi-process job
-    every rank inherits the env var, and only the first bind on a host
-    can win — the rest must still be able to ``import deepspeed_tpu``."""
+    """Honor ``DS_METRICS_PORT`` (off when unset; ``0`` = ephemeral
+    port, so N replicas on one host never collide — ISSUE 11).  Bind
+    failures degrade to a warning, never an import error: in a
+    multi-process job every rank inherits the env var, and only the
+    first bind on a host can win a FIXED port — the rest must still be
+    able to ``import deepspeed_tpu``."""
     port = os.environ.get("DS_METRICS_PORT", "")
-    if not port or port == "0":
+    if not port:
         return None
     try:
         return start_http_server(int(port))

@@ -14,13 +14,18 @@
 #                      speculative decoding on and checks the SAME
 #                      structural parity (speculation may change only
 #                      throughput/metrics, ISSUE 10)
-#   4. metric lint   — tools/check_metrics.py (naming convention +
+#   4. fleet smoke   — tools/fleetctl.py --smoke (ISSUE 11): spin two
+#                      debug serving replicas on ephemeral metrics
+#                      ports, scrape both, and assert the federated
+#                      /fleet view is EXACTLY the sum of its parts
+#                      (counters and histogram bucket counts)
+#   5. metric lint   — tools/check_metrics.py (naming convention +
 #                      DESIGN.md documentation + no dead metrics for
 #                      every ds_* metric)
-#   5. bench gate    — tools/check_bench.py --strict (latest vs
+#   6. bench gate    — tools/check_bench.py --strict (latest vs
 #                      previous BENCH_r*.json; throughput -10% /
 #                      latency +15% tolerances, cross-backend rounds
-#                      downgraded to notes)
+#                      downgraded to notes, fleet keys ±30/40%)
 #
 # Usage: tools/ci.sh [extra pytest args for the tier-1 leg]
 # Environment: JAX_PLATFORMS defaults to cpu (the CI mesh);
@@ -43,6 +48,9 @@ python -m pytest tests/ -q -m chaos -p no:cacheprovider
 echo "== workload replay smoke (incl. speculative pass) =="
 python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
     --limit 32 --spec --check > /dev/null
+
+echo "== fleetctl federation smoke =="
+python tools/fleetctl.py --smoke
 
 echo "== metric namespace lint =="
 python tools/check_metrics.py

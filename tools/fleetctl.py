@@ -1,0 +1,336 @@
+#!/usr/bin/env python
+"""fleetctl (ISSUE 11): see N serving replicas as one fleet.
+
+A stdlib-only CLI over the federation layer
+(``deepspeed_tpu/telemetry/federation.py``): scrape each replica's
+``/snapshot?raw=1``, merge (counters sum, gauges roll up min/max/sum,
+log-bucketed histograms merge EXACTLY), and print status / JSON /
+Prometheus text.  Also hosts the two-replica smoke used by
+``tools/ci.sh`` and the replica-kill fleet bench behind bench.py's
+``BENCH_FLEET=1`` leg.
+
+Usage::
+
+    python tools/fleetctl.py --targets 127.0.0.1:9001,127.0.0.1:9002
+        [status|json|metrics] [--watch SECONDS]
+    python tools/fleetctl.py --smoke       # CI: two debug replicas,
+                                           # merged counters == sum
+    python tools/fleetctl.py --kill-demo   # bench: two replicas, one
+                                           # killed mid-replay via the
+                                           # serving.preempt chaos site
+
+Targets are ``[label=]host:port`` (labels default to r0, r1, ...).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+REPLICA = os.path.join(REPO_ROOT, "tools", "fleet_replica.py")
+
+
+# -- replica process management (smoke / kill-demo / bench) ------------------
+class ReplicaProc:
+    """A fleet_replica.py child with a line-buffered stdout reader."""
+
+    def __init__(self, label: str, args: Optional[List[str]] = None,
+                 env_extra: Optional[Dict[str, str]] = None):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(env_extra or {})
+        self.label = label
+        self.proc = subprocess.Popen(
+            [sys.executable, REPLICA, "--label", label] + (args or []),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, start_new_session=True)
+        self.lines: List[str] = []
+        self._t = threading.Thread(target=self._read, daemon=True)
+        self._t.start()
+
+    def _read(self) -> None:
+        for line in self.proc.stdout:
+            self.lines.append(line.rstrip("\n"))
+
+    def wait_line(self, needle: str, timeout: float) -> Optional[str]:
+        deadline = time.monotonic() + timeout
+        seen = 0
+        while time.monotonic() < deadline:
+            while seen < len(self.lines):
+                if needle in self.lines[seen]:
+                    return self.lines[seen]
+                seen += 1
+            if self.proc.poll() is not None and seen >= len(self.lines):
+                return None
+            time.sleep(0.05)
+        return None
+
+    def port(self, timeout: float = 120.0) -> int:
+        line = self.wait_line("FLEET_REPLICA ready", timeout)
+        if line is None:
+            raise RuntimeError(
+                f"replica {self.label} never reported ready "
+                f"(exit={self.proc.poll()})")
+        return int(line.split("port=")[1].split()[0])
+
+    def terminate(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def _federation(targets: List[Tuple[str, int]], stale_after_s=None):
+    from deepspeed_tpu.telemetry.federation import Federation
+    fed = Federation() if stale_after_s is None else Federation(
+        stale_after_s=stale_after_s)
+    for label, port in targets:
+        fed.add_http(label, f"127.0.0.1:{port}")
+    return fed
+
+
+# -- CI smoke ----------------------------------------------------------------
+def run_smoke() -> int:
+    """Spin two debug replicas, scrape, assert the merged fleet view IS
+    the sum of its parts (counters and histogram counts, exactly)."""
+    reps = [ReplicaProc("r0", ["--rounds", "1", "--seed", "0"]),
+            ReplicaProc("r1", ["--rounds", "1", "--seed", "1"])]
+    try:
+        targets = [(r.label, r.port()) for r in reps]
+        for r in reps:
+            if r.wait_line("FLEET_REPLICA done", 180.0) is None:
+                raise RuntimeError(
+                    f"replica {r.label} did not finish its round")
+        fed = _federation(targets)
+        view = fed.scrape()
+        if view["stale"]:
+            raise RuntimeError(f"stale replicas in smoke: "
+                               f"{view['replicas']}")
+        parts = []
+        import urllib.request
+        for label, port in targets:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/snapshot?raw=1",
+                    timeout=5) as resp:
+                parts.append(json.loads(resp.read().decode()))
+        for name, merged in sorted(view["counters"].items()):
+            want = sum(p["counters"].get(name, 0) for p in parts)
+            if merged != want:
+                raise RuntimeError(
+                    f"merged counter {name}: {merged} != sum of parts "
+                    f"{want}")
+        for name, h in sorted(view["hists"].items()):
+            want = sum(p["hists"][name]["count"] for p in parts
+                       if name in p.get("hists", {}))
+            if h["count"] != want:
+                raise RuntimeError(
+                    f"merged histogram {name}: count {h['count']} != "
+                    f"sum of parts {want}")
+        toks = view["counters"].get("ds_fastgen_tokens_total", 0)
+        if toks <= 0:
+            raise RuntimeError("no tokens counted across the fleet")
+        print(f"fleetctl smoke: OK — 2 replicas, "
+              f"{len(view['counters'])} merged counters == sum of "
+              f"parts, {len(view['hists'])} histograms merged exactly, "
+              f"{toks} fleet tokens")
+        return 0
+    finally:
+        for r in reps:
+            r.terminate()
+
+
+# -- replica-kill fleet event (BENCH_FLEET) ----------------------------------
+def run_kill_demo(step_sleep_s: float = 0.05, rounds: int = 150,
+                  kill_at_step: int = 90,
+                  sample_every_s: float = 0.2,
+                  run_s: float = 20.0) -> Dict[str, Any]:
+    """Two live replicas replaying the checked-in CAPTURED trace
+    (``tools/traces/sample_200.jsonl``, anonymized prompt synthesis
+    per replica seed); one is killed mid-replay through the
+    ``serving.preempt`` chaos site.  The parent federates both,
+    samples a FLEET time-series ring, and runs the SLO burn-rate
+    evaluator over it — returns the ``fastgen_fleet_*`` bench keys
+    (aggregate tok/s, merged p99 TTFT across the kill event, the page
+    verdict and its advice)."""
+    from deepspeed_tpu.telemetry.registry import percentile_from_counts
+    from deepspeed_tpu.telemetry.slo import SLOEvaluator
+    from deepspeed_tpu.telemetry.timeseries import TimeSeries
+
+    # --trace-limit 4 keeps per-step compute small relative to the
+    # pacing sleep, so the token rate tracks the number of LIVE
+    # replicas (the signal) rather than CPU contention (noise)
+    common = ["--trace",
+              os.path.join(REPO_ROOT, "tools", "traces",
+                           "sample_200.jsonl"),
+              "--trace-limit", "4",
+              "--rounds", str(rounds),
+              "--step-sleep-s", str(step_sleep_s)]
+    reps = [
+        ReplicaProc("r0", common + ["--seed", "0"]),
+        ReplicaProc("r1", common + ["--seed", "1"],
+                    env_extra={
+                        "DS_CHAOS": f"serving.preempt:at={kill_at_step}"}),
+    ]
+    try:
+        targets = [(r.label, r.port()) for r in reps]
+        fed = _federation(targets, stale_after_s=2.0)
+        ts = TimeSeries(source=fed.merged_raw)
+        ts.configure(interval_s=sample_every_s, retention_s=600.0)
+        ev = SLOEvaluator()
+        ev.attach(timeseries=ts, federation=fed)
+
+        # let both replicas pass their compile warmup (round 0) before
+        # measuring the both-alive rate the objective is set from
+        for r in reps:
+            if r.wait_line("round=0 done", 300.0) is None:
+                raise RuntimeError(
+                    f"replica {r.label} never finished round 0 "
+                    f"(exit={r.proc.poll()})")
+        ts.sample_now()
+        time.sleep(max(4 * sample_every_s, 2.4))
+        ts.sample_now()
+        warm_rate = ts.counter_rate("ds_fastgen_tokens_total", 5.0) or 0.0
+        if warm_rate <= 0:
+            # min_per_s = 0 would be rejected by the objective
+            # validator anyway — fail with the real story instead
+            raise RuntimeError(
+                "no fleet tokens observed in the warm window — "
+                "replicas too slow for the demo pacing?")
+        if reps[1].proc.poll() is not None:
+            raise RuntimeError(
+                "replica r1 died before the both-alive rate was "
+                "measured — raise kill_at_step")
+        ev.configure([{
+            "name": "fleet_goodput", "kind": "throughput_min",
+            "counter": "ds_fastgen_tokens_total",
+            "min_per_s": 0.8 * warm_rate, "budget": 0.1,
+            "fast_window_s": 2.0, "slow_window_s": 4.0,
+            "page_burn": 2.0, "warn_burn": 0.5,
+        }])
+
+        t0 = time.monotonic()
+        tok0 = (fed.scrape()["counters"]
+                .get("ds_fastgen_tokens_total", 0))
+        paged = advice = surv_rate = None
+        kill_seen_at = None
+        while time.monotonic() - t0 < run_s:
+            time.sleep(sample_every_s)
+            ts.sample_now()     # evaluator rides the on-sample hook
+            if kill_seen_at is None and reps[1].proc.poll() is not None:
+                kill_seen_at = round(time.monotonic() - t0, 2)
+            cur = ev.current()
+            if paged is None and cur["status"] == "page":
+                v = cur["objectives"]["fleet_goodput"]
+                paged = round(time.monotonic() - t0, 2)
+                advice = v["advice"]
+                # the survivor's rate AT page time, while it still runs
+                surv_rate = ts.counter_rate(
+                    "ds_fastgen_tokens_total", 2.0)
+                break
+            if (reps[0].proc.poll() is not None
+                    or any("FLEET_REPLICA done" in ln
+                           for ln in reps[0].lines)):
+                # the survivor finished its workload — stop before the
+                # end-of-traffic rate drop masquerades as the kill
+                break
+        wall = time.monotonic() - t0
+        view = fed.scrape()
+        toks = view["counters"].get("ds_fastgen_tokens_total", 0) - tok0
+        th = view["hists"].get("ds_fastgen_ttft_ms")
+        ttft_p99 = (round(percentile_from_counts(
+            th["bounds"], th["counts"], th["count"], 99), 2)
+            if th and th["count"] else None)
+        return {
+            "fastgen_fleet_tok_s": round(toks / wall, 1),
+            "fastgen_fleet_ttft_p99_ms": ttft_p99,
+            "fastgen_fleet_warm_tok_s": round(warm_rate, 1),
+            "fastgen_fleet_survivor_tok_s": (
+                round(surv_rate, 1) if surv_rate is not None else None),
+            "fastgen_fleet_replicas": len(reps),
+            "fastgen_fleet_stale": view["stale"],
+            "fastgen_fleet_kill_observed_s": kill_seen_at,
+            "fastgen_fleet_paged_at_s": paged,
+            "fastgen_fleet_advice": advice,
+        }
+    finally:
+        for r in reps:
+            r.terminate()
+
+
+# -- CLI ---------------------------------------------------------------------
+def _status_text(view: Dict[str, Any]) -> str:
+    lines = [f"fleet: {view['live']} live, {view['stale']} stale"]
+    for label, st in sorted(view["replicas"].items()):
+        mark = "STALE" if st["stale"] else "up"
+        err = f" ({st['error']})" if st["error"] else ""
+        lines.append(f"  {label:<8} {mark:<6} {st['target']}"
+                     f" age={st['age_s']}s{err}")
+    c = view["counters"]
+    for key in ("ds_fastgen_tokens_total", "ds_serving_steps_total",
+                "ds_fastgen_shed_total"):
+        if key in c:
+            lines.append(f"  {key} = {c[key]}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("command", nargs="?", default="status",
+                    choices=["status", "json", "metrics"])
+    ap.add_argument("--targets", default="",
+                    help="comma-separated [label=]host:port replica "
+                    "list (or DS_FLEET_TARGETS)")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="repeat every N seconds")
+    ap.add_argument("--smoke", action="store_true",
+                    help="spin two debug replicas and assert the "
+                    "merged view == sum of parts (CI)")
+    ap.add_argument("--kill-demo", action="store_true",
+                    help="two replicas, one killed mid-replay; print "
+                    "the fleet bench keys")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        try:
+            return run_smoke()
+        except RuntimeError as e:
+            print(f"fleetctl smoke: FAILED — {e}", file=sys.stderr)
+            return 1
+    if args.kill_demo:
+        print(json.dumps(run_kill_demo(), indent=1))
+        return 0
+
+    targets = args.targets or os.environ.get("DS_FLEET_TARGETS", "")
+    if not targets:
+        print("fleetctl: no --targets (or DS_FLEET_TARGETS)",
+              file=sys.stderr)
+        return 2
+    from deepspeed_tpu.telemetry.federation import Federation
+    fed = Federation()
+    fed.configure_targets(targets)
+    while True:
+        if args.command == "json":
+            print(json.dumps(fed.snapshot_json(), indent=1))
+        elif args.command == "metrics":
+            print(fed.prometheus_text(), end="")
+        else:
+            print(_status_text(fed.scrape()))
+        if not args.watch:
+            return 0
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
