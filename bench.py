@@ -699,6 +699,26 @@ def bench_fastgen(jax):
                 sys.stderr.write(f"bench: fastgen fleet leg failed: "
                                  f"{e}\n")
                 result["fastgen_fleet_error"] = str(e)[:300]
+        if os.environ.get("BENCH_POOL", "0") != "0":
+            # replica-pool leg (ISSUE 12): the replayed shared-prefix
+            # trace through one replica, two round-robin replicas, two
+            # affinity-routed replicas, and the affinity pool with an
+            # abrupt replica KILL + scale-up ADD mid-replay (threaded
+            # replicas, per-step pacing as the simulated device
+            # budget, every engine pre-warmed).  Emits aggregate tok/s
+            # vs single, affinity-vs-round-robin prefix hit rate, p99
+            # TTFT before/after the kill, and migrated/lost request
+            # counts — the ROADMAP item 1 acceptance numbers.  Off by
+            # default (builds three engines); own try.
+            try:
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                from tools.fleetctl import run_pool_demo
+                result.update(run_pool_demo())
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: fastgen pool leg failed: "
+                                 f"{e}\n")
+                result["fastgen_pool_error"] = str(e)[:300]
         return result
     except Exception as e:  # noqa: BLE001 — aux leg must not kill the bench
         sys.stderr.write(f"bench: fastgen leg failed: {e}\n")

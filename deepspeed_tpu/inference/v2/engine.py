@@ -175,6 +175,26 @@ class InferenceEngineV2:
         recorder.record("engine.build", engine="fastgen",
                         kv_pages=kv_cfg.num_pages,
                         page_size=kv_cfg.page_size)
+        self._bind_digest_source()
+
+    def _bind_digest_source(self) -> None:
+        """Publish this engine's prefix-cache affinity hints on the
+        process metrics endpoint (``/snapshot?digests=1``, ISSUE 12) so
+        a pool router can scrape them like any other replica fact.
+        Weakref-bound, newest engine wins — the ds_kv_* gauge
+        convention."""
+        import weakref
+        from ...telemetry import server as tserver
+        ref = weakref.ref(self)
+
+        def _digests(top_k: int, r=ref) -> dict:
+            eng = r()
+            if eng is None:
+                return {"page_size": 0, "digests": []}
+            return {"page_size": eng.model.kv_config.page_size,
+                    "digests": eng.export_digests(top_k)}
+
+        tserver.set_digest_source(_digests)
 
     def _bind_kv_gauges(self) -> None:
         """Bind the ``ds_kv_*`` page-state gauges to this engine's live
@@ -637,6 +657,14 @@ class InferenceEngineV2:
         hit = self._state.match_prefix(sd, prompt)
         serving_counters.record_prefix_lookup(len(prompt), hit)
         return hit
+
+    def export_digests(self, top_k: int = 64) -> List[str]:
+        """Bounded prefix-cache affinity hint (ISSUE 12): the ``top_k``
+        most-recently-used cumulative page digests as hex, most recent
+        first (empty when caching is off).  This is the ONLY cache
+        introspection a pool router needs — it never scrapes the full
+        index or any page contents."""
+        return self._state.export_digests(top_k)
 
     def reset_prefix_cache(self) -> None:
         """Drop every cache entry and return parked pages to the pool

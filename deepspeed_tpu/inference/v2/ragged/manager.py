@@ -174,6 +174,15 @@ class StateManager:
                 sd.last_digest = digest
                 sd.indexed_pages = i + 1
 
+    def export_digests(self, top_k: int = 64) -> List[str]:
+        """The prefix cache's bounded affinity hint (ISSUE 12): up to
+        ``top_k`` most-recently-used cumulative digests as hex, most
+        recent first; empty when caching is off.  No page ids or KV
+        contents — safe to publish to a pool router."""
+        if self.prefix_cache is None:
+            return []
+        return self.prefix_cache.export_digests(top_k)
+
     def reset_prefix_cache(self) -> None:
         """Drop the whole index and reclaim its parked pages (bench
         cold-start; live sequences' pages free normally at flush)."""

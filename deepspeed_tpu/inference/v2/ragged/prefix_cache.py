@@ -140,6 +140,18 @@ class PrefixCache:
                 self._entries.move_to_end(d)
         return out
 
+    def export_digests(self, top_k: int = 64) -> List[str]:
+        """Bounded affinity hint (ISSUE 12): the ``top_k``
+        most-recently-used cumulative digests as hex strings, most
+        recent FIRST — no page ids, no KV contents, O(top_k) to build.
+        This is the slice a replica publishes to the pool router so
+        same-prefix requests can be routed to the replica that already
+        holds the pages; the full index never leaves the process."""
+        if top_k <= 0:
+            return []
+        from itertools import islice
+        return [d.hex() for d in islice(reversed(self._entries), top_k)]
+
     def export_entries(self) -> List[Tuple[bytes, int]]:
         """Every (digest, page) binding in LRU order, oldest first —
         the serving-snapshot serialization (ISSUE 8).  Re-importing via

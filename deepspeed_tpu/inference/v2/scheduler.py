@@ -504,6 +504,21 @@ class FastGenScheduler:
                     or self._inflight is not None)
 
     @property
+    def backlog(self) -> int:
+        """Live request count (pending + running + preempted) — the
+        pool router's least-backlog placement signal (ISSUE 12; the
+        same quantity the ``ds_fastgen_queue_depth``/``_running``/
+        ``_preempted`` gauges expose to remote scrapers)."""
+        return (len(self._pending) + len(self._running)
+                + len(self._preempted))
+
+    @property
+    def closed(self) -> bool:
+        """Admission stopped (close()/drain-for-snapshot); reversible
+        only via :meth:`reopen` while the scheduler is still alive."""
+        return self._closed
+
+    @property
     def _fused(self) -> bool:
         """Fused serving, gated on strict-shapes coherence: an engine
         precompiled WITHOUT the fused sample/chain variants
@@ -1299,6 +1314,21 @@ class FastGenScheduler:
         snapshot path — a scheduler being serialized must not accept
         work the bundle won't contain."""
         self._closed = True
+
+    def reopen(self) -> None:
+        """Resume admission on a drained-but-alive scheduler (ISSUE 12
+        satellite).  ``close()`` is one-way for the snapshot path — the
+        bundle must not race new admissions — but an ABORTED scale-down
+        (the pool decided to keep this replica after all, or
+        ``drain_and_snapshot`` wrote its bundle and the migration was
+        cancelled) used to leave the replica permanently returning
+        ``RequestError(code="closing")``.  The scheduler's engine state
+        is untouched by close/drain, so reopening is just lifting the
+        admission latch; any snapshot taken while closed stays valid
+        for the state AT snapshot time."""
+        self._closed = False
+        get_flight_recorder().record("fastgen.reopen",
+                                     backlog=self.backlog)
 
     @staticmethod
     def _serialize_request(req: Request, now: float) -> dict:

@@ -1,0 +1,10 @@
+"""Replica-pool serving (ISSUE 12, ROADMAP item 1): N FastGenScheduler
+replicas behind a prefix-affinity router with live migration and
+SLO-driven autoscaling."""
+
+from .pool import PoolRequest, ReplicaPool
+from .router import (POLICIES, PrefixAffinityRouter, RouteDecision,
+                     fetch_remote_hints)
+
+__all__ = ["ReplicaPool", "PoolRequest", "PrefixAffinityRouter",
+           "RouteDecision", "POLICIES", "fetch_remote_hints"]

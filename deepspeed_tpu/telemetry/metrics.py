@@ -6,7 +6,8 @@ one place to drift from — and ``tools/check_metrics.py`` lints this
 registry against docs/DESIGN.md's metric table in tier-1.
 
 Naming convention: ``ds_<area>_<name>`` with area one of
-{serving, comm, kv, train, fastgen, chaos, fleet, slo, telemetry};
+{serving, comm, kv, train, fastgen, chaos, fleet, slo, telemetry,
+pool};
 counters end in ``_total``.
 """
 
@@ -227,6 +228,34 @@ SLO_PAGES = registry.counter(
 SLO_WARNS = registry.counter(
     "ds_slo_warns_total",
     "SLO objective transitions into the warn verdict (from ok)")
+
+# -- replica pool (ISSUE 12) --------------------------------------------------
+POOL_REPLICAS = registry.gauge(
+    "ds_pool_replicas",
+    "live replicas fronted by the ReplicaPool router")
+POOL_ROUTED = registry.counter(
+    "ds_pool_routed_total",
+    "requests placed on a replica by the pool router")
+POOL_AFFINITY_ROUTED = registry.counter(
+    "ds_pool_affinity_routed_total",
+    "requests placed by prefix-digest affinity (the rest fell back to "
+    "least-backlog / round-robin)")
+POOL_MIGRATED = registry.counter(
+    "ds_pool_migrated_requests_total",
+    "in-flight requests re-homed to a peer replica (drain-and-migrate "
+    "scale-down or abrupt replica death), partial tokens kept")
+POOL_SCALE_UP = registry.counter(
+    "ds_pool_scale_up_total", "replicas added to the pool")
+POOL_SCALE_DOWN = registry.counter(
+    "ds_pool_scale_down_total",
+    "replicas drained, migrated away, and removed from the pool")
+POOL_REBALANCE = registry.counter(
+    "ds_pool_rebalance_total",
+    "hot digest groups re-homed to a colder replica")
+POOL_REPLICA_DEATHS = registry.counter(
+    "ds_pool_replica_deaths_total",
+    "replicas that died abruptly (preemption/kill) and had their "
+    "tracked requests resubmitted to survivors")
 
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(

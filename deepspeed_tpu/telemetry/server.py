@@ -8,7 +8,10 @@ config's ``telemetry.metrics_port``).  Serves:
   ``?window=<seconds>`` returns delta-windowed values from the
   time-series ring (ISSUE 11) instead of lifetime cumulatives;
   ``?raw=1`` returns the structured raw snapshot with histogram bucket
-  counts — the body the fleet federation merges exactly
+  counts — the body the fleet federation merges exactly;
+  ``?digests=1[&top_k=N]`` returns the live engine's bounded
+  prefix-cache affinity hint (ISSUE 12) — hex digests only, never page
+  contents — so a pool router can scrape placement hints per replica
 - ``/fleet``    — the federation's merged ``ds_fleet_*`` view over the
   configured replica targets (text; ``?json=1`` for JSON)
 - ``/trace``    — current span ring buffer as Chrome-trace JSON
@@ -41,6 +44,20 @@ from .tracer import get_tracer
 
 _server: Optional[ThreadingHTTPServer] = None
 _lock = threading.Lock()
+
+#: process-wide prefix-digest provider (ISSUE 12): the live inference
+#: engine binds a weakref'd callable at build (newest engine wins — the
+#: ds_kv_* gauge convention) and ``/snapshot?digests=1[&top_k=N]``
+#: serves its bounded affinity hint so a pool router can scrape a
+#: replica's cache hints like any other replica fact
+_digest_source = None
+
+
+def set_digest_source(fn) -> None:
+    """Register the ``(top_k: int) -> {"page_size", "digests"}``
+    provider behind ``/snapshot?digests=1`` (None to clear)."""
+    global _digest_source
+    _digest_source = fn
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
@@ -92,7 +109,17 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _snapshot_doc(self, params):
-        """(/snapshot body, error) honoring ``window`` and ``raw``."""
+        """(/snapshot body, error) honoring ``digests``, ``window`` and
+        ``raw``."""
+        if params.get("digests", ["0"])[0] not in ("", "0"):
+            if _digest_source is None:
+                return None, ("no inference engine has bound a digest "
+                              "source in this process")
+            try:
+                top_k = int(params.get("top_k", ["64"])[0])
+            except ValueError:
+                return None, "top_k must be an integer"
+            return _digest_source(max(0, min(top_k, 4096))), None
         if "window" in params:
             try:
                 window_s = float(params["window"][0])

@@ -60,6 +60,10 @@ CHAOS_TESTS = frozenset([
     # ISSUE 11: the two-replica federation demo kills a live replica
     # through the serving.preempt chaos site mid-replay
     "tests/test_fleet_observatory.py::TestTwoReplicaKillDemo::test_fleet_coherent_and_evaluator_pages_through_replica_kill",
+    # ISSUE 12: the replica pool replays the captured trace while the
+    # serving.preempt site kills a replica mid-replay; the pool absorbs
+    # the death and a scale_up restores capacity with zero lost requests
+    "tests/test_replica_pool.py::TestPoolKillAddReplay::test_replayed_kill_add_loses_nothing",
 ])
 
 HEAVY_TESTS = frozenset([

@@ -1,0 +1,489 @@
+"""Replica-pool serving (ISSUE 12): prefix-affinity router, live
+migration, SLO-driven autoscaling.
+
+Covers the tentpole — routing by chained page-digest affinity with
+least-backlog fallback, drain-and-migrate scale-down and abrupt-death
+absorption with partial tokens kept (greedy continuations tokenwise
+identical to the uninterrupted run), SLO-advice handling — plus the
+satellites: ``PrefixCache.export_digests`` (bounded, LRU-ordered, no
+contents) through engine and ``/snapshot?digests=1``, and
+``FastGenScheduler.reopen()`` after an aborted scale-down.  The
+chaos-marked kill/add test replays the checked-in captured trace
+through the pool while the ``serving.preempt`` site kills a replica
+mid-replay, and asserts every request still ends as tokens or a
+structured error with monotone pool counters.
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from deepspeed_tpu.inference.v2 import (
+    FastGenScheduler, InferenceEngineV2, KVCacheConfig,
+    RaggedInferenceEngineConfig, RaggedInferenceModel, SamplingParams,
+    StateManagerConfig)
+from deepspeed_tpu.inference.v2.ragged import PrefixCache
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+from deepspeed_tpu.runtime.fault_injection import get_fault_injector
+from deepspeed_tpu.serving import (PrefixAffinityRouter, ReplicaPool,
+                                   RouteDecision)
+from deepspeed_tpu.telemetry import metrics as tm
+
+PAGE = 16
+
+
+def _mk_engine(num_pages=64, max_seqs=8, max_batch=256):
+    model_def = LlamaForCausalLM("debug", max_seq_len=256,
+                                 dtype=jnp.float32)
+    params = meta.unbox(model_def.init_params(jax.random.key(0)))
+    cfg = model_def.cfg
+    kv_cfg = KVCacheConfig(num_layers=cfg.num_layers,
+                           kv_heads=cfg.kv_heads,
+                           head_dim=cfg.dims_per_head, page_size=PAGE,
+                           num_pages=num_pages, dtype=jnp.float32)
+    model = RaggedInferenceModel(cfg, params, kv_config=kv_cfg)
+    return InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        state_manager=StateManagerConfig(
+            max_tracked_sequences=max_seqs,
+            max_ragged_sequence_count=max_seqs,
+            max_ragged_batch_size=max_batch)))
+
+
+#: module-scoped engine cache: every pool test reuses these (identical
+#: weights — jax.random.key(0) init — so cross-replica greedy
+#: migration is tokenwise deterministic), reset to cold state between
+#: tests
+_ENGINES = {}
+
+
+def _engine(label):
+    eng = _ENGINES.get(label)
+    if eng is None:
+        eng = _mk_engine()
+        _ENGINES[label] = eng
+    return eng
+
+
+def _reset_all():
+    for eng in _ENGINES.values():
+        for uid in list(eng.state_manager._seqs):
+            eng.flush(uid)
+        eng.reset_prefix_cache()
+
+
+def _pool(replicas=2, **kw):
+    _reset_all()
+    return ReplicaPool(lambda label: FastGenScheduler(_engine(label)),
+                       replicas=replicas, **kw)
+
+
+def _prompt(seed, n=40):
+    return ((np.arange(n) * 7 + seed * 131 + 3) % 97).astype(np.int32)
+
+
+GREEDY8 = SamplingParams(max_new_tokens=8, temperature=0.0)
+
+
+# -- router units (no engine) -------------------------------------------------
+class TestRouter:
+    def test_digest_chain_matches_prefix_cache_scheme(self):
+        r = PrefixAffinityRouter(PAGE)
+        p = _prompt(0, 40)
+        digests = r.prompt_digests(p)
+        assert len(digests) == 2        # 40 tokens -> 2 full pages
+        d = PrefixCache.chain(b"", p[:PAGE])
+        assert digests[0] == d.hex()
+        assert digests[1] == PrefixCache.chain(d, p[PAGE:2 * PAGE]).hex()
+
+    def test_affinity_routes_to_digest_holder(self):
+        r = PrefixAffinityRouter(PAGE)
+        p = _prompt(0)
+        r.publish("a", r.prompt_digests(p))
+        # "a" is busier, but it holds the prefix — affinity wins
+        dec = r.decide(p, {"a": 5, "b": 0})
+        assert dec == RouteDecision("a", 2, "affinity")
+
+    def test_longest_match_wins(self):
+        r = PrefixAffinityRouter(PAGE)
+        p = _prompt(0, 64)              # 4 full pages
+        d = r.prompt_digests(p)
+        r.publish("short", d[:1])
+        r.publish("long", d[:3])
+        dec = r.decide(p, {"short": 0, "long": 9})
+        assert dec.label == "long" and dec.matched_pages == 3
+
+    def test_cold_prompt_goes_least_backlog(self):
+        r = PrefixAffinityRouter(PAGE)
+        r.publish("a", r.prompt_digests(_prompt(0)))
+        dec = r.decide(_prompt(7), {"a": 0, "b": 3, "c": 1})
+        assert dec.label == "a" and dec.reason == "backlog"
+        dec = r.decide(_prompt(7), {"a": 2, "b": 3, "c": 1})
+        assert dec.label == "c"
+
+    def test_round_robin_cycles_and_ignores_hints(self):
+        r = PrefixAffinityRouter(PAGE, policy="round_robin")
+        p = _prompt(0)
+        r.publish("b", r.prompt_digests(p))
+        labels = [r.decide(p, {"a": 0, "b": 0}).label for _ in range(4)]
+        assert labels == ["a", "b", "a", "b"]
+        assert all(r.decide(p, {"a": 0, "b": 0}).matched_pages == 0
+                   for _ in range(2))
+
+    def test_pin_overrides_affinity_and_forget_drops(self):
+        r = PrefixAffinityRouter(PAGE)
+        p = _prompt(0)
+        d = r.prompt_digests(p)
+        r.publish("a", d)
+        r.pin(d[0], "b")
+        assert r.decide(p, {"a": 0, "b": 9}).label == "b"
+        r.forget("b")                   # dead replica: pin must not dangle
+        assert r.decide(p, {"a": 9}).label == "a"
+
+    def test_partial_page_prompt_has_no_digests(self):
+        r = PrefixAffinityRouter(PAGE)
+        assert r.prompt_digests(_prompt(0, PAGE - 1)) == []
+        dec = r.decide(_prompt(0, PAGE - 1), {"a": 1, "b": 0})
+        assert dec.label == "b" and dec.reason == "backlog"
+
+    def test_hottest_group_tracks_placements(self):
+        r = PrefixAffinityRouter(PAGE)
+        p = _prompt(0)
+        r.publish("a", r.prompt_digests(p))
+        for _ in range(3):
+            r.decide(p, {"a": 0, "b": 0})
+        assert r.hottest_group("a") == r.prompt_digests(p)[0]
+        assert r.hottest_group("b") is None
+
+    def test_empty_pool_raises_and_bad_policy_rejected(self):
+        r = PrefixAffinityRouter(PAGE)
+        with pytest.raises(ValueError):
+            r.decide(_prompt(0), {})
+        with pytest.raises(ValueError):
+            PrefixAffinityRouter(PAGE, policy="nope")
+
+
+# -- export_digests satellite -------------------------------------------------
+class TestExportDigests:
+    def test_lru_order_bounded_and_content_free(self):
+        pc = PrefixCache(PAGE)
+        toks = [np.full(PAGE, i, np.int32) for i in range(5)]
+        digs = []
+        d = b""
+        for i, t in enumerate(toks):
+            d = PrefixCache.chain(b"", t)
+            pc.insert(d, i)
+            digs.append(d.hex())
+        out = pc.export_digests(3)
+        assert out == [digs[4], digs[3], digs[2]]   # most recent first
+        # a match LRU-touches its digest to the recent end
+        pc.match(toks[0], 4)
+        assert pc.export_digests(1) == [digs[0]]
+        assert pc.export_digests(0) == []
+        assert all(isinstance(s, str) and len(s) == 32
+                   for s in pc.export_digests(5))
+
+    def test_engine_and_manager_passthrough(self):
+        _reset_all()
+        eng = _engine("r0")
+        sched = FastGenScheduler(eng)
+        p = _prompt(3)
+        sched.submit(0, p, SamplingParams(max_new_tokens=2,
+                                          temperature=0.0))
+        sched.run_to_completion()
+        digs = eng.export_digests(8)
+        assert digs  # the prompt's full pages were indexed at commit
+        r = PrefixAffinityRouter(PAGE)
+        want = r.prompt_digests(p)
+        assert set(want) <= set(digs)
+        assert eng.state_manager.export_digests(2) == digs[:2]
+
+    def test_snapshot_digests_endpoint(self):
+        from deepspeed_tpu.telemetry.server import (start_http_server,
+                                                    stop_http_server)
+        _reset_all()
+        eng = _engine("r0")
+        eng._bind_digest_source()   # newest-wins: rebind to this engine
+        sched = FastGenScheduler(eng)
+        sched.submit(0, _prompt(5), SamplingParams(max_new_tokens=2,
+                                                   temperature=0.0))
+        sched.run_to_completion()
+        srv = start_http_server(0)
+        try:
+            port = srv.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/snapshot?digests=1&top_k=4",
+                    timeout=5) as resp:
+                doc = json.loads(resp.read().decode())
+            assert doc["page_size"] == PAGE
+            assert doc["digests"] == eng.export_digests(4)
+        finally:
+            stop_http_server()
+
+
+# -- scheduler satellites: reopen + backlog -----------------------------------
+class TestReopen:
+    def test_closed_then_reopen_serves_again(self):
+        _reset_all()
+        sched = FastGenScheduler(_engine("r0"))
+        sched.close()
+        verdict = sched.submit(0, _prompt(0), GREEDY8)
+        assert verdict is not None and verdict.code == "closing"
+        assert sched.closed
+        sched.reopen()
+        assert not sched.closed
+        assert sched.submit(1, _prompt(0), GREEDY8) is None
+        out = sched.run_to_completion()
+        assert len(out[1]) == 8
+
+    def test_aborted_scale_down_resumes_mid_flight_work(self, tmp_path):
+        """drain_and_snapshot wrote its bundle, the migration was then
+        cancelled — reopen() must resume the SAME scheduler: the still
+        -queued requests finish tokenwise identical to an uninterrupted
+        run, and new admissions are accepted again."""
+        _reset_all()
+        baseline = FastGenScheduler(_engine("r1"))
+        for uid in range(3):
+            baseline.submit(uid, _prompt(uid), GREEDY8)
+        want = baseline.run_to_completion()
+
+        _reset_all()
+        sched = FastGenScheduler(_engine("r0"))
+        for uid in range(3):
+            sched.submit(uid, _prompt(uid), GREEDY8)
+        for _ in range(2):
+            sched.step()
+        path = str(tmp_path / "abort.snap")
+        assert sched.drain_and_snapshot(path, grace_s=30.0) == path
+        assert sched.submit(9, _prompt(9), GREEDY8).code == "closing"
+        sched.reopen()
+        assert sched.submit(9, _prompt(9), GREEDY8) is None
+        got = sched.run_to_completion()
+        for uid in range(3):
+            assert got[uid] == want[uid]
+        assert len(got[9]) == 8
+
+    def test_backlog_counts_all_queues(self):
+        _reset_all()
+        sched = FastGenScheduler(_engine("r0"))
+        assert sched.backlog == 0
+        for uid in range(3):
+            sched.submit(uid, _prompt(uid), GREEDY8)
+        assert sched.backlog == 3
+        sched.run_to_completion()
+        assert sched.backlog == 0
+
+
+# -- pool routing + migration -------------------------------------------------
+class TestPoolRouting:
+    def test_warm_prefix_lands_on_digest_holder(self):
+        pool = _pool(replicas=2)
+        p = _prompt(1)
+        assert pool.submit(0, p, GREEDY8) is None
+        pool.run_to_completion()
+        pool.publish_hints()
+        home = pool.request(0).replica
+        hits0 = tm.SERVING_PREFIX_HIT_TOKENS.value
+        assert pool.submit(1, p, GREEDY8) is None
+        req = pool.request(1)
+        assert req.replica == home and req.matched_pages == 2
+        pool.run_to_completion()
+        assert tm.SERVING_PREFIX_HIT_TOKENS.value > hits0
+
+    def test_cold_prompt_goes_least_backlog(self):
+        pool = _pool(replicas=2)
+        # load one replica, then a cold prompt must go to the other
+        assert pool.submit(0, _prompt(1), GREEDY8) is None
+        busy = pool.request(0).replica
+        assert pool.submit(1, _prompt(2), GREEDY8) is None
+        req = pool.request(1)
+        assert req.replica != busy and req.matched_pages == 0
+        pool.run_to_completion()
+        assert not pool.errors
+
+    def test_duplicate_live_uid_rejected(self):
+        pool = _pool(replicas=1)
+        pool.submit(0, _prompt(0), GREEDY8)
+        with pytest.raises(ValueError):
+            pool.submit(0, _prompt(0), GREEDY8)
+        pool.run_to_completion()
+
+
+class TestPoolMigration:
+    def _uninterrupted(self, uids):
+        pool = _pool(replicas=1)
+        for uid in uids:
+            pool.submit(uid, _prompt(uid), GREEDY8)
+        return pool.run_to_completion()
+
+    def test_scale_down_migrates_with_tokenwise_parity(self):
+        want = self._uninterrupted(range(4))
+        pool = _pool(replicas=2)
+        migrated0 = tm.POOL_MIGRATED.value
+        for uid in range(4):
+            pool.submit(uid, _prompt(uid), GREEDY8)
+        for _ in range(3):
+            pool.step()
+        committed = {u: list(pool.request(u).tokens) for u in range(4)}
+        gone = pool.scale_down()
+        assert gone is not None and len(pool.labels) == 1
+        got = pool.run_to_completion()
+        assert not pool.errors
+        for uid in range(4):
+            # committed prefix preserved verbatim; greedy continuation
+            # tokenwise identical to the uninterrupted run
+            assert got[uid][:len(committed[uid])] == committed[uid]
+            assert got[uid] == want[uid]
+        assert tm.POOL_MIGRATED.value > migrated0
+
+    def test_scale_down_refuses_last_replica(self):
+        pool = _pool(replicas=1)
+        assert pool.scale_down() is None
+
+    def test_abrupt_kill_absorbed_with_parity(self):
+        want = self._uninterrupted(range(4))
+        pool = _pool(replicas=2)
+        deaths0 = tm.POOL_REPLICA_DEATHS.value
+        for uid in range(4):
+            pool.submit(uid, _prompt(uid), GREEDY8)
+        for _ in range(2):
+            pool.step()
+        pool.kill(pool.labels[0])
+        got = pool.run_to_completion()
+        assert not pool.errors
+        for uid in range(4):
+            assert got[uid] == want[uid]
+        assert tm.POOL_REPLICA_DEATHS.value == deaths0 + 1
+
+    def test_kill_last_replica_orphans_then_scale_up_recovers(self):
+        want = self._uninterrupted([0, 1])
+        pool = _pool(replicas=1)
+        for uid in (0, 1):
+            pool.submit(uid, _prompt(uid), GREEDY8)
+        pool.step()
+        pool.kill(pool.labels[0])
+        assert pool.stats()["orphans"] == 2
+        assert pool.scale_up() is not None
+        got = pool.run_to_completion()
+        for uid in (0, 1):
+            assert got[uid] == want[uid]
+
+
+# -- SLO advice ---------------------------------------------------------------
+class _FakeEvaluator:
+    """Duck-typed stand-in for telemetry.slo.SLOEvaluator.current()."""
+
+    def __init__(self, advice=None):
+        self.advice = advice
+
+    def current(self):
+        if self.advice is None:
+            return {"configured": False, "status": "ok", "objectives": {}}
+        return {"configured": True, "status": "page", "objectives": {
+            "obj": {"status": "page", "advice": self.advice}}}
+
+
+class TestPoolAdvice:
+    def test_scale_up_advice_spawns_replica_under_cooldown(self):
+        pool = _pool(replicas=1, max_replicas=2)
+        ev = _FakeEvaluator("scale_up")
+        pool.attach_slo(ev, cooldown_s=60.0)
+        pool.step()
+        assert len(pool.labels) == 2
+        pool.step()                     # cooldown: no third attempt
+        assert len(pool.labels) == 2
+
+    def test_max_replicas_bounds_scale_up(self):
+        pool = _pool(replicas=2, max_replicas=2)
+        assert pool.scale_up() is None
+
+    def test_scale_down_advice_drains_and_migrates(self):
+        pool = _pool(replicas=2)
+        for uid in range(3):
+            pool.submit(uid, _prompt(uid), GREEDY8)
+        pool.step()
+        assert pool.handle_advice("scale_down") is not None
+        assert len(pool.labels) == 1
+        got = pool.run_to_completion()
+        assert all(len(got[u]) == 8 for u in range(3))
+
+    def test_rebalance_pins_hottest_group_to_coldest_replica(self):
+        pool = _pool(replicas=2)
+        p = _prompt(1)
+        pool.submit(0, p, GREEDY8)
+        pool.run_to_completion()
+        pool.publish_hints()
+        for uid in (1, 2):              # heat up the digest holder
+            pool.submit(uid, p, GREEDY8)
+        hot = pool.request(1).replica
+        assert pool.request(2).replica == hot
+        root = pool.rebalance()
+        assert root is not None
+        pool.run_to_completion()
+        # the pinned group now routes to the OTHER replica
+        pool.submit(3, p, GREEDY8)
+        assert pool.request(3).replica != hot
+        pool.run_to_completion()
+
+    def test_unconfigured_evaluator_is_inert(self):
+        pool = _pool(replicas=1)
+        pool.attach_slo(_FakeEvaluator(None), cooldown_s=0.0)
+        pool.step()
+        assert len(pool.labels) == 1
+
+
+# -- chaos: replayed-trace kill/add -------------------------------------------
+class TestPoolKillAddReplay:
+    def test_replayed_kill_add_loses_nothing(self):
+        """Replay the checked-in captured trace through a two-replica
+        affinity pool while the ``serving.preempt`` chaos site kills a
+        replica mid-replay; scale a fresh replica back up.  Every
+        request must still end as tokens (exact recorded gen lengths)
+        or a structured error, pool counters stay monotone, and the
+        pre-kill committed prefixes survive verbatim."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+        from tools.fleetctl import (_pool_factory, _pool_params,
+                                    _pool_workload)
+
+        meta_d, requests, prompts = _pool_workload(10)
+        params = _pool_params(requests)
+        engines = {}
+        pool = ReplicaPool(_pool_factory(meta_d, requests, engines),
+                           replicas=2)
+        routed0 = tm.POOL_ROUTED.value
+        deaths0 = tm.POOL_REPLICA_DEATHS.value
+        for i in range(len(requests)):
+            assert pool.submit(i, prompts[i], params[i]) is None
+        for _ in range(4):
+            pool.step()
+        committed = {i: list(pool.request(i).tokens)
+                     for i in range(len(requests))}
+        fi = get_fault_injector()
+        try:
+            # the next scheduler step (whichever replica takes it)
+            # raises the SIGTERM-equivalent preemption fault
+            fi.configure({"serving.preempt": {"at_calls": [1]}})
+            pool.step()
+        finally:
+            fi.disarm()
+        assert tm.POOL_REPLICA_DEATHS.value == deaths0 + 1
+        assert len(pool.labels) == 1
+        assert pool.scale_up() is not None
+        assert len(pool.labels) == 2
+        pool.run_to_completion()
+        results = pool.results()
+        for i, rec in enumerate(requests):
+            if i in results:
+                assert len(results[i]) == max(1, int(rec["gen_len"]))
+                assert results[i][:len(committed[i])] == committed[i]
+            else:
+                assert i in pool.errors     # structured, never silent
+        assert len(results) + len(pool.errors) == len(requests)
+        assert not pool.errors      # nothing sheds at this scale
+        assert tm.POOL_ROUTED.value - routed0 >= len(requests)

@@ -36,16 +36,16 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 THROUGHPUT_DROP_TOL = 0.10   # throughput may not drop >10%
 LATENCY_GROW_TOL = 0.15      # SLO latencies may not grow >15%
-#: fastgen_fleet_* keys span a deliberate replica-kill chaos event
-#: (ISSUE 11) — kill timing jitter moves them far more than steady
-#: legs, so they get their own wider tolerances
+#: fastgen_fleet_* and pool_* keys span a deliberate replica-kill
+#: chaos event (ISSUE 11/12) — kill timing jitter moves them far more
+#: than steady legs, so they get their own wider tolerances
 FLEET_DROP_TOL = 0.30
 FLEET_GROW_TOL = 0.40
 
 _THROUGHPUT_RE = re.compile(
-    r"(^value$|_tok_s$|_req_s$|_hit_rate$|goodput)")
+    r"(^value$|_tok_s$|_req_s$|_hit_rate$|goodput|_speedup_)")
 _LATENCY_RE = re.compile(r"_ms$")
-_FLEET_RE = re.compile(r"^fastgen_fleet_")
+_FLEET_RE = re.compile(r"^(fastgen_fleet_|pool_)")
 #: parsed keys that are not a measured quantity at all
 _SKIP_RE = re.compile(
     r"(^metric$|^unit$|error|^cpu_fallback$|_model$|_path$|_policy$|"
@@ -140,6 +140,32 @@ def spec_findings(cur: Dict) -> List[str]:
     return []
 
 
+def pool_findings(cur: Dict) -> List[str]:
+    """In-round replica-pool gate (ISSUE 12): the kill/add demo's
+    invariants — no request may be lost across a migration, the
+    two-replica affinity pool should beat a single replica by >= 1.5x,
+    and affinity routing's prefix hit rate must be strictly above the
+    round-robin control arm on the shared-prefix trace."""
+    out: List[str] = []
+    lost = cur.get("pool_lost_requests")
+    if isinstance(lost, (int, float)) and lost > 0:
+        out.append(f"replica-pool kill/add demo LOST {lost} request(s) "
+                   "— migration must end every request as tokens or a "
+                   "structured error")
+    sp = cur.get("pool_speedup_vs_single")
+    if isinstance(sp, (int, float)) and sp < 1.5:
+        out.append(f"pool aggregate tok/s only {sp}x a single replica "
+                   "across the kill/add event (target >= 1.5x)")
+    aff = cur.get("pool_prefix_hit_rate_affinity")
+    rr = cur.get("pool_prefix_hit_rate_round_robin")
+    if (isinstance(aff, (int, float)) and isinstance(rr, (int, float))
+            and aff <= rr):
+        out.append(f"affinity routing's prefix hit rate ({aff}) is not "
+                   f"above round-robin's ({rr}) on the shared-prefix "
+                   "trace — check hint publication / router matching")
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--dir", default=REPO_ROOT,
@@ -174,6 +200,7 @@ def main(argv=None) -> int:
 
     findings = compare(prev, cur)
     findings += [("note", m) for m in spec_findings(cur)]
+    findings += [("note", m) for m in pool_findings(cur)]
     regressions = [m for sev, m in findings if sev == "regression"]
     notes = [m for sev, m in findings if sev == "note"]
     label = (f"{os.path.basename(prev_path)} -> "

@@ -19,10 +19,16 @@
 #                      ports, scrape both, and assert the federated
 #                      /fleet view is EXACTLY the sum of its parts
 #                      (counters and histogram bucket counts)
-#   5. metric lint   — tools/check_metrics.py (naming convention +
+#   5. pool smoke    — tools/fleetctl.py --pool-smoke (ISSUE 12): two
+#                      in-process replicas behind the prefix-affinity
+#                      router replay the first 32 requests of the
+#                      checked-in trace; one replica is drain-migrated
+#                      away mid-replay; asserts exact gen-length parity
+#                      and ZERO lost requests
+#   6. metric lint   — tools/check_metrics.py (naming convention +
 #                      DESIGN.md documentation + no dead metrics for
 #                      every ds_* metric)
-#   6. bench gate    — tools/check_bench.py --strict (latest vs
+#   7. bench gate    — tools/check_bench.py --strict (latest vs
 #                      previous BENCH_r*.json; throughput -10% /
 #                      latency +15% tolerances, cross-backend rounds
 #                      downgraded to notes, fleet keys ±30/40%)
@@ -51,6 +57,9 @@ python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
 
 echo "== fleetctl federation smoke =="
 python tools/fleetctl.py --smoke
+
+echo "== replica-pool router smoke (migrate mid-replay) =="
+python tools/fleetctl.py --pool-smoke
 
 echo "== metric namespace lint =="
 python tools/check_metrics.py

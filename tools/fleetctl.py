@@ -1,24 +1,33 @@
 #!/usr/bin/env python
-"""fleetctl (ISSUE 11): see N serving replicas as one fleet.
+"""fleetctl (ISSUE 11/12): see and drive N serving replicas as one
+fleet.
 
 A stdlib-only CLI over the federation layer
 (``deepspeed_tpu/telemetry/federation.py``): scrape each replica's
 ``/snapshot?raw=1``, merge (counters sum, gauges roll up min/max/sum,
 log-bucketed histograms merge EXACTLY), and print status / JSON /
 Prometheus text.  Also hosts the two-replica smoke used by
-``tools/ci.sh`` and the replica-kill fleet bench behind bench.py's
-``BENCH_FLEET=1`` leg.
+``tools/ci.sh``, the replica-kill fleet bench behind bench.py's
+``BENCH_FLEET=1`` leg, and the ISSUE 12 replica-pool legs: the CI
+pool smoke (two in-process replicas behind the prefix-affinity router,
+one migrated mid-replay) and the ``BENCH_POOL=1`` kill/add demo.
 
 Usage::
 
     python tools/fleetctl.py --targets 127.0.0.1:9001,127.0.0.1:9002
-        [status|json|metrics] [--watch SECONDS]
+        [status|json|metrics|digests] [--watch SECONDS]
     python tools/fleetctl.py --smoke       # CI: two debug replicas,
                                            # merged counters == sum
     python tools/fleetctl.py --kill-demo   # bench: two replicas, one
                                            # killed mid-replay via the
                                            # serving.preempt chaos site
+    python tools/fleetctl.py --pool-smoke  # CI: replica pool, affinity
+                                           # router, migrate mid-replay
+    python tools/fleetctl.py --pool-demo   # bench: pool kill/add demo
+                                           # (BENCH_POOL keys)
 
+``digests`` prints each target's ``/snapshot?digests=1`` prefix-cache
+affinity hint — the subprocess-mode routing input (ISSUE 12).
 Targets are ``[label=]host:port`` (labels default to r0, r1, ...).
 """
 
@@ -269,6 +278,294 @@ def run_kill_demo(step_sleep_s: float = 0.05, rounds: int = 150,
             r.terminate()
 
 
+# -- replica pool (ISSUE 12): CI smoke + BENCH_POOL kill/add demo ------------
+SAMPLE_TRACE = os.path.join(REPO_ROOT, "tools", "traces",
+                            "sample_200.jsonl")
+
+
+def _pool_workload(limit: int):
+    """Load the checked-in captured trace and synthesize the anonymized
+    shared-prefix prompts (the ISSUE 9 machinery) — the replayed
+    workload every pool leg drives."""
+    from tools.replay_trace import load_trace, synthesize_prompts
+    trace = load_trace(SAMPLE_TRACE)
+    requests = [r for r in trace["requests"]
+                if r.get("outcome") == "ok"][:limit]
+    meta = trace["meta"]
+    page = int(meta.get("page_size", 16))
+    vocab = int(meta.get("vocab_size", 128))
+    prompts = synthesize_prompts(requests, page, vocab, seed=0)
+    return meta, requests, prompts
+
+
+def _pool_factory(meta, requests, engines: Dict[str, Any],
+                  max_seqs: int = 8):
+    """A ReplicaPool factory that caches one engine per label (so a
+    warmup pass can pre-compile the engines a later measured pass —
+    including its post-kill scale_up — will use)."""
+    from deepspeed_tpu.inference.v2 import FastGenScheduler
+    from tools.replay_trace import build_replay_engine
+
+    def factory(label: str):
+        eng = engines.get(label)
+        if eng is None:
+            eng = build_replay_engine(meta, requests, max_seqs=max_seqs)
+            engines[label] = eng
+        return FastGenScheduler(eng)
+
+    return factory
+
+
+def _pool_params(requests):
+    from deepspeed_tpu.inference.v2 import SamplingParams
+    return [SamplingParams(
+        temperature=float(r.get("temperature", 0.0)),
+        top_k=int(r.get("top_k", 0)), top_p=float(r.get("top_p", 1.0)),
+        max_new_tokens=max(1, int(r["gen_len"]))) for r in requests]
+
+
+def _reset_engines(engines: Dict[str, Any]) -> None:
+    from tools.replay_trace import _reset_engine
+    for eng in engines.values():
+        _reset_engine(eng)
+
+
+def run_pool_smoke(limit: int = 32) -> int:
+    """CI leg (ISSUE 12): two in-process replicas behind the
+    prefix-affinity router replay the first ``limit`` requests of the
+    checked-in captured trace; one replica is drain-migrated away
+    mid-replay.  Asserts structural parity (request count + exact
+    generated lengths) and ZERO lost requests (every request ends as
+    tokens or a structured error — here: tokens), with the pool
+    counters monotone through the membership change."""
+    from deepspeed_tpu.serving import ReplicaPool
+    from deepspeed_tpu.telemetry import metrics as tm
+
+    meta, requests, prompts = _pool_workload(limit)
+    params = _pool_params(requests)
+    engines: Dict[str, Any] = {}
+    pool = ReplicaPool(_pool_factory(meta, requests, engines),
+                       replicas=2)
+    routed0 = tm.POOL_ROUTED.value
+    migrated0 = tm.POOL_MIGRATED.value
+    for i in range(len(requests)):
+        verdict = pool.submit(i, prompts[i], params[i])
+        if verdict is not None:
+            raise RuntimeError(
+                f"pool smoke: request {i} rejected at submit: "
+                f"{verdict.code}")
+    for _ in range(6):      # let both replicas get in-flight work
+        pool.step()
+    gone = pool.scale_down()
+    if gone is None:
+        raise RuntimeError("pool smoke: scale_down refused with two "
+                           "live replicas")
+    pool.run_to_completion()
+    results = pool.results()
+    problems = []
+    if pool.errors:
+        problems.append(f"structured errors: "
+                        f"{ {u: e.code for u, e in pool.errors.items()} }")
+    if len(results) != len(requests):
+        problems.append(f"request count: {len(results)} completed vs "
+                        f"{len(requests)} submitted")
+    for i, rec in enumerate(requests):
+        want = max(1, int(rec["gen_len"]))
+        got = len(results.get(i, []))
+        if got != want:
+            problems.append(f"req {i}: gen_len {got} vs recorded {want}")
+    routed = tm.POOL_ROUTED.value - routed0
+    migrated = tm.POOL_MIGRATED.value - migrated0
+    if routed < len(requests):
+        problems.append(f"routed counter not monotone/complete: "
+                        f"{routed} < {len(requests)}")
+    if migrated < 1:
+        problems.append("no request migrated across the scale_down")
+    if len(pool.labels) != 1:
+        problems.append(f"expected 1 surviving replica, have "
+                        f"{pool.labels}")
+    if problems:
+        for p in problems:
+            print(f"fleetctl pool smoke: {p}", file=sys.stderr)
+        raise RuntimeError("pool smoke failed")
+    print(f"fleetctl pool smoke: OK — {len(requests)} requests through "
+          f"2 replicas, {gone} drain-migrated mid-replay "
+          f"({migrated} requests re-homed, partial tokens kept), "
+          f"0 lost, exact gen-length parity")
+    return 0
+
+
+def _pool_run_pass(meta, requests, prompts, params, engines,
+                   n_replicas: int, policy: str, pace_s: float,
+                   wave: int, wave_gap_s: float,
+                   kill_add: bool = False,
+                   timeout_s: float = 180.0) -> Dict[str, Any]:
+    """One measured pool pass over the replayed workload: threaded
+    replicas, wave-paced submission (so earlier group members commit
+    and warm the cache before later ones arrive — time-scaled pacing
+    split across the router).  With ``kill_add``, the busiest replica
+    is killed abruptly once ~40% of requests completed and a fresh
+    replica is added shortly after."""
+    from deepspeed_tpu.serving import ReplicaPool
+    from deepspeed_tpu.telemetry import metrics as tm
+    from tools.replay_trace import percentile
+
+    # hint_every=1: publish affinity hints every step so placement is
+    # timing-insensitive (export_digests is O(top_k) host work)
+    pool = ReplicaPool(_pool_factory(meta, requests, engines),
+                       replicas=n_replicas, policy=policy,
+                       hint_every=1)
+    look0 = tm.SERVING_PREFIX_LOOKUP_TOKENS.value
+    hit0 = tm.SERVING_PREFIX_HIT_TOKENS.value
+    migr0 = tm.POOL_MIGRATED.value
+    pool.start(pace_s=pace_s)
+    t0 = time.monotonic()
+    kill_done = add_done = False
+    kill_mono = None
+    i = 0
+    try:
+        while True:
+            now = time.monotonic()
+            due = min(len(requests), (int((now - t0) / wave_gap_s) + 1)
+                      * wave)
+            while i < due:
+                pool.submit(i, prompts[i], params[i])
+                i += 1
+            stats = pool.stats()
+            if (kill_add and not kill_done
+                    and stats["completed"] >= 0.4 * len(requests)):
+                victim = max(stats["backlogs"] or {"": 0},
+                             key=lambda lb: stats["backlogs"].get(lb, 0))
+                if victim:
+                    pool.kill(victim)
+                    kill_mono = time.monotonic()
+                    kill_done = True
+            if (kill_done and not add_done
+                    and time.monotonic() - kill_mono > 0.3):
+                pool.scale_up()
+                add_done = True
+            if i >= len(requests) and pool.serve_until_idle(0.05):
+                break
+            if time.monotonic() - t0 > timeout_s:
+                raise RuntimeError(f"pool pass timed out "
+                                   f"({policy}, kill_add={kill_add})")
+            time.sleep(0.005)
+    finally:
+        pool.stop()
+    wall = time.monotonic() - t0
+    reqs = [pool.request(u) for u in range(len(requests))]
+    toks = sum(len(r.tokens) for r in reqs if r is not None)
+    ttft = [(r.first_token_mono - r.submit_mono) * 1e3 for r in reqs
+            if r is not None and r.first_token_mono]
+    out = {
+        "tok_s": round(toks / wall, 1) if wall else None,
+        "wall_s": round(wall, 3),
+        "completed": sum(1 for r in reqs if r is not None and r.done),
+        "lost": sum(1 for r in reqs
+                    if r is None or not r.finalized),
+        "errors": {u: e.code for u, e in pool.errors.items()},
+        "ttft_p99_ms": percentile(ttft, 99),
+        "hit_rate": round(
+            (tm.SERVING_PREFIX_HIT_TOKENS.value - hit0)
+            / max(tm.SERVING_PREFIX_LOOKUP_TOKENS.value - look0, 1), 4),
+        "migrated": tm.POOL_MIGRATED.value - migr0,
+    }
+    if kill_add and kill_mono is not None:
+        before = [(r.first_token_mono - r.submit_mono) * 1e3
+                  for r in reqs if r is not None and r.first_token_mono
+                  and r.first_token_mono <= kill_mono]
+        after = [(r.first_token_mono - r.submit_mono) * 1e3
+                 for r in reqs if r is not None and r.first_token_mono
+                 and r.first_token_mono > kill_mono]
+        out["ttft_p99_ms_before_kill"] = percentile(before, 99)
+        out["ttft_p99_ms_after_kill"] = percentile(after, 99)
+        out["kill_at_s"] = round(kill_mono - t0, 3)
+    return out
+
+
+def run_pool_demo(limit: int = 24, pace_s: float = 0.01,
+                  wave: int = 4, wave_gap_s: float = 0.15
+                  ) -> Dict[str, Any]:
+    """The BENCH_POOL leg (ISSUE 12): the replayed shared-prefix trace
+    driven through (a) one replica, (b) two replicas under round-robin
+    routing (the affinity control arm), (c) two replicas under the
+    prefix-affinity router, and (d) the affinity pool with an abrupt
+    replica KILL mid-replay followed by a scale-up ADD — emitting the
+    acceptance keys: aggregate tok/s vs single replica, affinity vs
+    round-robin prefix hit rate, p99 TTFT before/after the kill, and
+    migrated-request/lost-request counts.  Every pass runs on
+    pre-warmed engines (one untimed warmup pass over three labels, so
+    even the post-kill replica is born compiled) with per-step pacing
+    as the simulated device budget — the signal is live parallelism
+    and cache placement, not CPU contention."""
+    meta, requests, prompts = _pool_workload(limit)
+    params = _pool_params(requests)
+    engines: Dict[str, Any] = {}
+
+    # untimed warmup: drive the FULL workload through each engine
+    # alone (r0..r2 — r2 is the post-kill scale_up home) so every
+    # engine compiles its largest slot buckets up front; measured
+    # passes then show placement/parallelism, not XLA compiles.  Reset
+    # to cold caches afterwards.
+    factory = _pool_factory(meta, requests, engines)
+    from tools.replay_trace import replay
+    for label in ("r0", "r1", "r2"):
+        factory(label)      # build + cache the engine
+        replay(engines[label], requests, prompts, speed=0.0)
+    _reset_engines(engines)
+
+    single = _pool_run_pass(meta, requests, prompts, params, engines,
+                            1, "affinity", pace_s, wave, wave_gap_s)
+    _reset_engines(engines)
+    rr = _pool_run_pass(meta, requests, prompts, params, engines,
+                        2, "round_robin", pace_s, wave, wave_gap_s)
+    _reset_engines(engines)
+    aff = _pool_run_pass(meta, requests, prompts, params, engines,
+                         2, "affinity", pace_s, wave, wave_gap_s)
+    _reset_engines(engines)
+    kill = _pool_run_pass(meta, requests, prompts, params, engines,
+                          2, "affinity", pace_s, wave, wave_gap_s,
+                          kill_add=True)
+    return {
+        "pool_requests": len(requests),
+        "pool_single_tok_s": single["tok_s"],
+        "pool_rr_tok_s": rr["tok_s"],
+        "pool_affinity_tok_s": aff["tok_s"],
+        "pool_agg_tok_s": kill["tok_s"],
+        "pool_speedup_vs_single": (
+            round(kill["tok_s"] / single["tok_s"], 3)
+            if single["tok_s"] else None),
+        "pool_prefix_hit_rate_affinity": aff["hit_rate"],
+        "pool_prefix_hit_rate_round_robin": rr["hit_rate"],
+        "pool_ttft_p99_ms_before_kill": kill.get(
+            "ttft_p99_ms_before_kill"),
+        "pool_ttft_p99_ms_after_kill": kill.get(
+            "ttft_p99_ms_after_kill"),
+        "pool_kill_at_s": kill.get("kill_at_s"),
+        "pool_migrated_requests": kill["migrated"],
+        "pool_lost_requests": kill["lost"],
+    }
+
+
+def _digests_text(targets: List[Tuple[str, str]], top_k: int = 8) -> str:
+    """Per-target ``/snapshot?digests=1`` affinity hints (the
+    subprocess-mode router input, ISSUE 12).  ``targets`` are
+    (label, host:port) pairs — the host passes through untouched."""
+    from deepspeed_tpu.serving import fetch_remote_hints
+    lines = []
+    for label, target in targets:
+        try:
+            doc = fetch_remote_hints(target, top_k=top_k)
+            digests = doc.get("digests", [])
+            lines.append(f"{label:<8} page_size={doc.get('page_size')} "
+                         f"digests={len(digests)}")
+            for d in digests:
+                lines.append(f"  {d}")
+        except Exception as e:  # noqa: BLE001 — any replica may be down
+            lines.append(f"{label:<8} UNREACHABLE ({e})")
+    return "\n".join(lines)
+
+
 # -- CLI ---------------------------------------------------------------------
 def _status_text(view: Dict[str, Any]) -> str:
     lines = [f"fleet: {view['live']} live, {view['stale']} stale"]
@@ -288,7 +585,7 @@ def _status_text(view: Dict[str, Any]) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("command", nargs="?", default="status",
-                    choices=["status", "json", "metrics"])
+                    choices=["status", "json", "metrics", "digests"])
     ap.add_argument("--targets", default="",
                     help="comma-separated [label=]host:port replica "
                     "list (or DS_FLEET_TARGETS)")
@@ -300,6 +597,16 @@ def main(argv=None) -> int:
     ap.add_argument("--kill-demo", action="store_true",
                     help="two replicas, one killed mid-replay; print "
                     "the fleet bench keys")
+    ap.add_argument("--pool-smoke", action="store_true",
+                    help="replica pool CI smoke: 2 in-process replicas "
+                    "behind the affinity router, one drain-migrated "
+                    "mid-replay; assert parity and zero lost requests")
+    ap.add_argument("--pool-demo", action="store_true",
+                    help="replica pool kill/add demo; print the "
+                    "BENCH_POOL keys")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="pool legs: replay only the first N trace "
+                    "requests (0 = leg default)")
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -308,8 +615,20 @@ def main(argv=None) -> int:
         except RuntimeError as e:
             print(f"fleetctl smoke: FAILED — {e}", file=sys.stderr)
             return 1
+    if args.pool_smoke:
+        try:
+            return run_pool_smoke(**({"limit": args.limit}
+                                     if args.limit else {}))
+        except RuntimeError as e:
+            print(f"fleetctl pool smoke: FAILED — {e}", file=sys.stderr)
+            return 1
     if args.kill_demo:
         print(json.dumps(run_kill_demo(), indent=1))
+        return 0
+    if args.pool_demo:
+        print(json.dumps(run_pool_demo(**({"limit": args.limit}
+                                          if args.limit else {})),
+                         indent=1))
         return 0
 
     targets = args.targets or os.environ.get("DS_FLEET_TARGETS", "")
@@ -320,6 +639,18 @@ def main(argv=None) -> int:
     from deepspeed_tpu.telemetry.federation import Federation
     fed = Federation()
     fed.configure_targets(targets)
+    if args.command == "digests":
+        pairs = []
+        for i, entry in enumerate(t.strip() for t in
+                                  targets.split(",") if t.strip()):
+            label, _, tgt = (entry.partition("=") if "=" in entry
+                             else (f"r{i}", "", entry))
+            pairs.append((label.strip(), tgt.strip()))
+        while True:
+            print(_digests_text(pairs))
+            if not args.watch:
+                return 0
+            time.sleep(args.watch)
     while True:
         if args.command == "json":
             print(json.dumps(fed.snapshot_json(), indent=1))
