@@ -699,6 +699,29 @@ def bench_fastgen(jax):
                 sys.stderr.write(f"bench: fastgen fleet leg failed: "
                                  f"{e}\n")
                 result["fastgen_fleet_error"] = str(e)[:300]
+        if os.environ.get("BENCH_DISAGG", "0") != "0":
+            # disaggregated prefill/decode leg (ISSUE 13): the
+            # replayed mixed trace (decode-weighted via
+            # BENCH_DISAGG_GEN_SCALE) through the fused single-pool
+            # scheduler and the two-pool disagg scheduler, both with
+            # keyed sampling so the output-identity check covers the
+            # trace's SAMPLED requests.  Emits prefill-pool MFU and
+            # decode-pool HBM GB/s vs the fused baseline's gauges
+            # (both must be strictly above), per-pool compiled /
+            # enumerated program counts vs the fused lattice's (below),
+            # handoff count/bytes/p50 ms, aggregate tok/s ratio,
+            # on-path compiles (0), lost requests (0), and
+            # disagg_tokenwise_identical.  Off by default (builds
+            # three engines); own try.
+            try:
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                from tools.replay_trace import run_disagg_bench
+                result.update(run_disagg_bench())
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: fastgen disagg leg failed: "
+                                 f"{e}\n")
+                result["fastgen_disagg_error"] = str(e)[:300]
         if os.environ.get("BENCH_POOL", "0") != "0":
             # replica-pool leg (ISSUE 12): the replayed shared-prefix
             # trace through one replica, two round-robin replicas, two

@@ -94,6 +94,21 @@ class ServingOptimizationConfig:
     #: (longer n-grams are tried first; raise to cut false drafts on
     #: low-repetition traffic)
     spec_ngram_min: int = 2
+    # -- disaggregated prefill/decode serving (ISSUE 13) ----------------
+    #: scheduler role: "both" (the fused single engine), "prefill"
+    #: (prompt chunks + FIRST token only; finished requests park as
+    #: handoff-ready for a DisaggPool to stream to a decode pool), or
+    #: "decode" (admits handoff imports only — a plain submit is
+    #: rejected with a structured RequestError(code="misrouted"))
+    role: str = "both"
+    #: schedule-invariant sampling: each sampled token's RNG key is
+    #: derived from (base key, request uid, generation position) on
+    #: device instead of one per-step key, so sampled output is
+    #: independent of batch composition/step count — required for a
+    #: disagg handoff (or migration) to continue SAMPLED requests
+    #: tokenwise identical to the fused engine.  Engine-build-time
+    #: (changes compiled program signatures); default off
+    keyed_sampling: bool = False
 
 
 @dataclasses.dataclass

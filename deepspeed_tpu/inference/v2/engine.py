@@ -47,17 +47,50 @@ class SchedulingError(RuntimeError):
         self.result = result
 
 
+#: key classes a role-shrunk lattice filters on (ISSUE 13): "prefill"
+#: = Q>1 logits/sample buckets (incl. fresh variants), "decode" = Q==1
+#: logits/sample buckets, "chain" = the double-buffer continuation
+#: family, "spec" = speculative verification buckets
+LATTICE_KINDS = ("prefill", "decode", "chain", "spec")
+
+
+def lattice_kind_of(key: Tuple) -> str:
+    """Which :data:`LATTICE_KINDS` class one step-cache key belongs
+    to — the shared classifier behind ``lattice_keys(kinds=...)``."""
+    kind = key[4] if len(key) > 4 else "logits"
+    if kind == "chain":
+        return "chain"
+    if kind == "spec":
+        return "spec"
+    return "prefill" if key[1] > 1 else "decode"
+
+
 def lattice_keys(max_prompt: int, max_new_tokens: int,
                  max_concurrency: int, page_size: int,
                  max_ragged_batch_size: int, has_fresh: bool,
-                 sampling: bool, spec_max_draft: int = 0) -> List[Tuple]:
+                 sampling: bool, spec_max_draft: int = 0,
+                 kinds: Optional[Sequence[str]] = None) -> List[Tuple]:
     """Every (S, Q, P[, fresh[, kind, ...]]) step-cache key the default
     power-of-two bucket lattice contains for this geometry — the ONE
     enumeration shared by ``InferenceEngineV2.precompile`` (which
     compiles it) and ``tools/analyze_trace.py`` (which reports observed
     traffic's coverage against it), so the two can't drift (ROADMAP
-    item 5's single lattice authority)."""
+    item 5's single lattice authority).
+
+    ``kinds`` (ISSUE 13) restricts the enumeration to a subset of
+    :data:`LATTICE_KINDS` so a disaggregated pool compiles only its
+    role's programs: a prefill pool takes ``("prefill", "decode")``
+    (decode-geometry keys cover budget-shrunk 1-token chunks and the
+    first-token sample; the chain/spec families drop), a decode pool
+    takes ``("decode", "chain", "spec")`` (every Q>1 prefill bucket
+    and its fresh variants drop).  None = the full fused lattice."""
     from .ragged.batch import MIN_PAGES, MIN_SLOTS, _bucket
+    if kinds is not None:
+        unknown = set(kinds) - set(LATTICE_KINDS)
+        if unknown:
+            raise ValueError(
+                f"unknown lattice kinds {sorted(unknown)} "
+                f"(expected a subset of {LATTICE_KINDS})")
 
     s_vals, q_vals, p_vals = [], [1], []
     s = _bucket(1, MIN_SLOTS)
@@ -122,6 +155,9 @@ def lattice_keys(max_prompt: int, max_new_tokens: int,
                     continue
                 for greedy in (True, False):
                     keys.append((S, q_spec, P, False, "spec", greedy))
+    if kinds is not None:
+        want = set(kinds)
+        keys = [k for k in keys if lattice_kind_of(k) in want]
     return keys
 
 
@@ -159,6 +195,11 @@ class InferenceEngineV2:
             model.kv_config = kv_cfg
         else:
             kv_cfg = model.kv_config
+        # keyed sampling (ISSUE 13) changes the traced signatures of
+        # every sampling-capable step kind, so it is fixed at engine
+        # build, before any precompile/lattice work
+        model.keyed_sampling = bool(
+            getattr(self._config.serving, "keyed_sampling", False))
         self._state = StateManager(
             kv_cfg,
             max_tracked_sequences=self._config.state_manager.max_tracked_sequences,
@@ -222,7 +263,8 @@ class InferenceEngineV2:
                    max_new_tokens: int = 256,
                    strict: bool = False,
                    sampling: bool = False,
-                   spec_max_draft: Optional[int] = None) -> List[Tuple]:
+                   spec_max_draft: Optional[int] = None,
+                   kinds: Optional[Sequence[str]] = None) -> List[Tuple]:
         """AOT-compile the (S, Q, P) bucket lattice this engine can hit
         (verdict on live serving: a first-use XLA compile is a TTFT
         spike; the reference captures CUDA graphs at engine build).
@@ -241,14 +283,18 @@ class InferenceEngineV2:
         the serving config's, 0 when ``speculative`` is off) widens the
         sampling lattice with the speculative Q = 1+draft verification
         buckets so a strict_shapes engine can't recompile on-path when
-        speculation is enabled.  Returns the compiled keys."""
+        speculation is enabled.  ``kinds`` (ISSUE 13) shrinks the
+        lattice to a disaggregated role's key classes and GUARDS the
+        shrink: a filter that re-enumerates the full lattice raises
+        (the whole point of a role-restricted pool is compiling fewer
+        programs).  Returns the compiled keys."""
         sm = self._config.state_manager
         kv = self._state.kv_cache.data
         if spec_max_draft is None:
             sv = self._config.serving
             spec_max_draft = (int(getattr(sv, "spec_max_draft", 0) or 0)
                               if getattr(sv, "speculative", False) else 0)
-        keys = lattice_keys(
+        kwargs = dict(
             max_prompt=max_prompt, max_new_tokens=max_new_tokens,
             max_concurrency=(max_concurrency
                              or sm.max_ragged_sequence_count),
@@ -257,6 +303,16 @@ class InferenceEngineV2:
             has_fresh=getattr(self._model, "_fresh_attention",
                               None) is not None,
             sampling=sampling, spec_max_draft=spec_max_draft)
+        keys = lattice_keys(kinds=kinds, **kwargs)
+        if kinds is not None:
+            full = len(lattice_keys(**kwargs))
+            if len(keys) >= full:
+                raise ValueError(
+                    f"precompile(kinds={tuple(kinds)}) enumerated "
+                    f"{len(keys)} keys but the full lattice has {full} "
+                    "— the role filter did not shrink the compiled set "
+                    "(silently re-enumerating both pools' programs "
+                    "defeats disaggregation's compile-time win)")
         for key in keys:
             self._model.precompile_step(key, kv)
         if strict:
@@ -494,10 +550,27 @@ class InferenceEngineV2:
             top_ps[i] = p.top_p
         return temps, top_ks, top_ps
 
+    def _pad_keyed(self, batch_uids, row_pos, S):
+        """Keyed-sampling inputs padded to the slot bucket: [S] int32
+        uid + generation-position arrays (padding rows sample garbage
+        nobody reads, like the padded sampling params).  (None, None)
+        when the mode is off — and ALSO when a keyed engine was
+        stepped without positions, so the model's guard raises instead
+        of this padding silently pinning every draw to position 0."""
+        if not self._model.keyed_sampling or row_pos is None:
+            return None, None
+        uids = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        uids[:len(batch_uids)] = np.asarray(batch_uids, np.int64) \
+            .astype(np.int32)
+        pos[:len(row_pos)] = np.asarray(row_pos, np.int32)
+        return uids, pos
+
     def step_sample(self, batch_uids: Sequence[int],
                     batch_tokens: Sequence[np.ndarray],
                     row_params: Sequence, rng: jax.Array,
-                    do_checks: bool = True
+                    do_checks: bool = True,
+                    row_pos: Optional[Sequence[int]] = None
                     ) -> Tuple[jax.Array, List[int]]:
         """One compiled program for a mixed SplitFuse step: fused
         forward + on-device sampling.  Returns (device token array
@@ -518,12 +591,14 @@ class InferenceEngineV2:
                 descs, [np.asarray(t) for t in batch_tokens])
             temps, top_ks, top_ps = self._pad_sample_params(
                 row_params, batch.num_slots)
+            kuids, kpos = self._pad_keyed(batch_uids, row_pos,
+                                          batch.num_slots)
             greedy_only = not bool((temps > 0.0).any())
             serving_counters.record_program(
                 h2d_bytes=temps.nbytes + top_ks.nbytes + top_ps.nbytes)
             tokens, self._state.kv_cache.data = self._model.sample_step(
                 batch, self._state.kv_cache.data, rng, temps, top_ks,
-                top_ps, greedy_only)
+                top_ps, greedy_only, row_uids=kuids, row_pos=kpos)
             self._commit_batch(descs)
             return tokens, list(range(len(batch_uids)))
 
@@ -547,12 +622,20 @@ class InferenceEngineV2:
                           for p in ordered_params]
         temps, top_ks, top_ps = self._pad_sample_params(
             ordered_params, len(ordered_params))
+        # keyed inputs follow the same segment order as the params
+        kuids = kpos = None
+        if self._model.keyed_sampling and row_pos is not None:
+            kuids = np.zeros(len(ordered_params), np.int32)
+            kpos = np.zeros(len(ordered_params), np.int32)
+            for i, row in enumerate(row_of_input):
+                kuids[row] = np.int64(batch_uids[i]).astype(np.int32)
+                kpos[row] = int(row_pos[i])
         greedy_only = not bool((temps > 0.0).any())
         serving_counters.record_program(
             h2d_bytes=temps.nbytes + top_ks.nbytes + top_ps.nbytes)
         tokens, self._state.kv_cache.data = self._model.sample_step_mixed(
             dec, pre, self._state.kv_cache.data, rng, temps, top_ks,
-            top_ps, greedy_only)
+            top_ps, greedy_only, row_uids=kuids, row_pos=kpos)
         self._commit_batch(descs)
         return tokens, row_of_input
 
@@ -560,7 +643,9 @@ class InferenceEngineV2:
                             prev_tokens: jax.Array,
                             gather_idx: Sequence[int],
                             row_params: Sequence,
-                            rng: jax.Array) -> jax.Array:
+                            rng: jax.Array,
+                            row_pos: Optional[Sequence[int]] = None
+                            ) -> jax.Array:
         """Decode-continuation step whose input token ids are gathered ON
         DEVICE from the previous step's sampled tokens (``prev_tokens``,
         possibly still in flight): row i continues the sequence that sat
@@ -577,19 +662,23 @@ class InferenceEngineV2:
         greedy_only = not bool((temps > 0.0).any())
         gather = np.zeros(batch.num_slots, np.int32)
         gather[:len(batch_uids)] = np.asarray(gather_idx, np.int32)
+        kuids, kpos = self._pad_keyed(batch_uids, row_pos,
+                                      batch.num_slots)
         serving_counters.record_program(
             h2d_bytes=temps.nbytes + top_ks.nbytes + top_ps.nbytes
             + gather.nbytes)
         tokens, self._state.kv_cache.data = self._model.chained_step(
             batch, self._state.kv_cache.data, prev_tokens, gather, rng,
-            temps, top_ks, top_ps, greedy_only)
+            temps, top_ks, top_ps, greedy_only,
+            row_uids=kuids, row_pos=kpos)
         self._commit_batch(descs)
         return tokens
 
     def step_spec(self, batch_uids: Sequence[int],
                   batch_tokens: Sequence[np.ndarray],
                   row_params: Sequence, rng: jax.Array,
-                  min_q: int = 1) -> jax.Array:
+                  min_q: int = 1,
+                  row_pos: Optional[Sequence[int]] = None) -> jax.Array:
         """Speculative verification step (ISSUE 10): each row's tokens
         are ``[last_committed, draft_1..draft_k]`` (k may differ per
         row, k = 0 allowed) and ONE compiled program verifies every
@@ -609,12 +698,14 @@ class InferenceEngineV2:
             descs, [np.asarray(t) for t in batch_tokens], min_q=min_q)
         temps, top_ks, top_ps = self._pad_sample_params(
             row_params, batch.num_slots)
+        kuids, kpos = self._pad_keyed(batch_uids, row_pos,
+                                      batch.num_slots)
         greedy_only = not bool((temps > 0.0).any())
         serving_counters.record_program(
             h2d_bytes=temps.nbytes + top_ks.nbytes + top_ps.nbytes)
         out, self._state.kv_cache.data = self._model.spec_step(
             batch, self._state.kv_cache.data, rng, temps, top_ks,
-            top_ps, greedy_only)
+            top_ps, greedy_only, row_uids=kuids, row_pos=kpos)
         return out
 
     def commit_spec(self, batch_uids: Sequence[int],

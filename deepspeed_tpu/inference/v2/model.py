@@ -188,6 +188,18 @@ class RaggedInferenceModel:
             params = T.meta.unbox(params) if T._has_boxes(params) else params
         self.params = params
         self._step_cache: Dict[Tuple[int, int, int], Callable] = {}
+        #: schedule-invariant sampling (ISSUE 13): when True every
+        #: sampling-capable step kind takes two extra [S] int32 inputs
+        #: (row uid, generation position) and draws each row's token
+        #: from a key derived ONLY from (base key, uid, position) —
+        #: sampled output becomes independent of batch composition and
+        #: step count, which is what lets a disaggregated prefill ->
+        #: decode handoff (or a migration) continue a sampled request
+        #: tokenwise identical to the fused single-engine run.  Set by
+        #: the engine from ``serving.keyed_sampling`` BEFORE any
+        #: precompile — it changes the traced program signatures, so it
+        #: is an engine-build-time fact, not a per-step toggle.
+        self.keyed_sampling = False
         # -- per-program cost accounting (ISSUE 9): flops/bytes from
         # compiled.cost_analysis() per step-cache key, accumulated per
         # dispatch so serving throughput gets a hardware denominator
@@ -272,9 +284,24 @@ class RaggedInferenceModel:
                           batch.start_pos, batch.page_table)
         return logits, kv
 
+    def _keyed_args(self, row_uids, row_pos) -> list:
+        """The two extra [S] int32 inputs of keyed-sampling programs
+        (empty list when the mode is off).  Callers that never sample a
+        row the host reads (padding, mid-prefill) may pass anything for
+        it — its draw is garbage nobody consumes."""
+        if not self.keyed_sampling:
+            return []
+        if row_uids is None or row_pos is None:
+            raise ValueError(
+                "keyed_sampling model requires row_uids/row_pos for "
+                "every sampling-capable step")
+        return [jnp.asarray(row_uids, jnp.int32),
+                jnp.asarray(row_pos, jnp.int32)]
+
     def sample_step(self, batch: RaggedBatch, kv: jax.Array,
                     rng: jax.Array, temps, top_ks, top_ps,
-                    greedy_only: bool) -> Tuple[jax.Array, jax.Array]:
+                    greedy_only: bool, row_uids=None, row_pos=None
+                    ) -> Tuple[jax.Array, jax.Array]:
         """One compiled program: forward + on-device sampling.  Returns
         (tokens [S] int32, new kv) — only the token array ever needs to
         cross device->host (ISSUE 2 tentpole b).  ``greedy_only`` is a
@@ -287,12 +314,13 @@ class RaggedInferenceModel:
                     batch.start_pos, batch.page_table, rng,
                     jnp.asarray(temps, jnp.float32),
                     jnp.asarray(top_ks, jnp.int32),
-                    jnp.asarray(top_ps, jnp.float32))
+                    jnp.asarray(top_ps, jnp.float32),
+                    *self._keyed_args(row_uids, row_pos))
 
     def sample_step_mixed(self, dec_batch: RaggedBatch,
                           pre_batch: RaggedBatch, kv: jax.Array,
                           rng: jax.Array, temps, top_ks, top_ps,
-                          greedy_only: bool
+                          greedy_only: bool, row_uids=None, row_pos=None
                           ) -> Tuple[jax.Array, jax.Array]:
         """Mixed SplitFuse step as ONE compiled program over TWO batch
         geometries: a decode segment [S_d, 1] and a prefill segment
@@ -314,11 +342,13 @@ class RaggedInferenceModel:
                     pre_batch.start_pos, pre_batch.page_table, rng,
                     jnp.asarray(temps, jnp.float32),
                     jnp.asarray(top_ks, jnp.int32),
-                    jnp.asarray(top_ps, jnp.float32))
+                    jnp.asarray(top_ps, jnp.float32),
+                    *self._keyed_args(row_uids, row_pos))
 
     def spec_step(self, batch: RaggedBatch, kv: jax.Array,
                   rng: jax.Array, temps, top_ks, top_ps,
-                  greedy_only: bool) -> Tuple[jax.Array, jax.Array]:
+                  greedy_only: bool, row_uids=None, row_pos=None
+                  ) -> Tuple[jax.Array, jax.Array]:
         """Speculative verification step (ISSUE 10): each decode row
         carries ``[last_committed, draft_1..draft_k]`` as a ragged
         Q = 1+k segment; ONE compiled program runs the forward over
@@ -335,11 +365,13 @@ class RaggedInferenceModel:
                     batch.start_pos, batch.page_table, rng,
                     jnp.asarray(temps, jnp.float32),
                     jnp.asarray(top_ks, jnp.int32),
-                    jnp.asarray(top_ps, jnp.float32))
+                    jnp.asarray(top_ps, jnp.float32),
+                    *self._keyed_args(row_uids, row_pos))
 
     def chained_step(self, batch: RaggedBatch, kv: jax.Array,
                      prev_tokens: jax.Array, gather_idx, rng: jax.Array,
-                     temps, top_ks, top_ps, greedy_only: bool
+                     temps, top_ks, top_ps, greedy_only: bool,
+                     row_uids=None, row_pos=None
                      ) -> Tuple[jax.Array, jax.Array]:
         """Decode-continuation step whose token ids come from the
         PREVIOUS step's on-device token output (``prev_tokens``) via a
@@ -356,7 +388,8 @@ class RaggedInferenceModel:
                     batch.start_pos, batch.page_table, rng,
                     jnp.asarray(temps, jnp.float32),
                     jnp.asarray(top_ks, jnp.int32),
-                    jnp.asarray(top_ps, jnp.float32))
+                    jnp.asarray(top_ps, jnp.float32),
+                    *self._keyed_args(row_uids, row_pos))
 
     def _normalize_key(self, key) -> Tuple[int, int, int, bool]:
         if getattr(self, "_fresh_attention", None) is None \
@@ -538,8 +571,13 @@ class RaggedInferenceModel:
         kind = key[4] if len(key) > 4 else "logits"
 
         def sample_avals(n):
-            return [jax.eval_shape(lambda: jax.random.key(0)),
-                    sds((n,), f32), sds((n,), i32), sds((n,), f32)]
+            avals = [jax.eval_shape(lambda: jax.random.key(0)),
+                     sds((n,), f32), sds((n,), i32), sds((n,), f32)]
+            if self.keyed_sampling:
+                # keyed sampling (ISSUE 13): row uid + generation
+                # position feed the on-device per-row key derivation
+                avals += [sds((n,), i32), sds((n,), i32)]
+            return avals
 
         if kind == "logits":
             return [self.params, kv_aval] + batch_avals
@@ -623,33 +661,49 @@ class RaggedInferenceModel:
             logits = logits + params["lm_head_bias"].astype(cfg.dtype)
         return logits.astype(jnp.float32), kv
 
+    def _sample_tokens(self, logits, rng, temps, top_ks, top_ps,
+                       row_uids, row_pos, greedy_only: bool):
+        """The one sampling reduction every sampling-capable step kind
+        shares: static greedy specialization, keyed per-row draws when
+        ``keyed_sampling`` (row key = f(base, uid, position) — schedule
+        invariant), else the step-keyed ``sample_dynamic``."""
+        if greedy_only:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if row_uids is not None:
+            from .sampling import derive_row_keys, sample_keyed
+            keys = derive_row_keys(rng, row_uids, row_pos)
+            return sample_keyed(logits, keys, temps, top_ks, top_ps)
+        from .sampling import sample_dynamic
+        return sample_dynamic(logits, rng, temps, top_ks, top_ps)
+
     def _sample_step_impl(self, params, kv, token_ids, q_lens, start_pos,
                           page_table, rng, temps, top_ks, top_ps,
+                          row_uids=None, row_pos=None,
                           fresh: bool = False, greedy_only: bool = False):
         """Forward + on-device sampling in ONE traced program: the [S, V]
         logits never leave the device — only int32 tokens do."""
         logits, kv = self._step_impl(params, kv, token_ids, q_lens,
                                      start_pos, page_table, fresh=fresh)
-        if greedy_only:
-            tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            from .sampling import sample_dynamic
-            tokens = sample_dynamic(logits, rng, temps, top_ks, top_ps)
+        tokens = self._sample_tokens(logits, rng, temps, top_ks, top_ps,
+                                     row_uids, row_pos, greedy_only)
         return tokens, kv
 
     def _chained_step_impl(self, params, kv, prev_tokens, gather_idx,
                            q_lens, start_pos, page_table, rng, temps,
-                           top_ks, top_ps, greedy_only: bool = False):
+                           top_ks, top_ps, row_uids=None, row_pos=None,
+                           greedy_only: bool = False):
         """Decode step whose token ids are gathered on device from the
         previous step's sampled tokens (slot mapping is host-known), so
         consecutive decode steps chain with no host round-trip."""
         token_ids = jnp.take(prev_tokens, gather_idx)[:, None]  # [S, 1]
         return self._sample_step_impl(
             params, kv, token_ids, q_lens, start_pos, page_table, rng,
-            temps, top_ks, top_ps, fresh=False, greedy_only=greedy_only)
+            temps, top_ks, top_ps, row_uids, row_pos,
+            fresh=False, greedy_only=greedy_only)
 
     def _spec_step_impl(self, params, kv, token_ids, q_lens, start_pos,
                         page_table, rng, temps, top_ks, top_ps,
+                        row_uids=None, row_pos=None,
                         greedy_only: bool = False):
         """Verify drafted tokens in one traced program.  Row layout:
         ``token_ids[s] = [last_committed, d_1..d_k, pad...]`` with
@@ -675,11 +729,20 @@ class RaggedInferenceModel:
         if greedy_only:
             emitted = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
-            from .sampling import sample_dynamic
-            emitted = sample_dynamic(
+            # keyed mode: position j of row s emits the token at
+            # generation index row_pos[s] + j — fold per position so a
+            # spec-committed block is bit-equal to the same tokens
+            # drawn one step at a time (the non-spec keyed stream)
+            sq_uids = (jnp.repeat(row_uids, Q) if row_uids is not None
+                       else None)
+            sq_pos = ((row_pos[:, None]
+                       + jnp.arange(Q, dtype=jnp.int32)[None, :]
+                       ).reshape(-1) if row_uids is not None else None)
+            emitted = self._sample_tokens(
                 logits.reshape(S * Q, V), rng,
                 jnp.repeat(temps, Q), jnp.repeat(top_ks, Q),
-                jnp.repeat(top_ps, Q)).reshape(S, Q)
+                jnp.repeat(top_ps, Q), sq_uids, sq_pos,
+                greedy_only=False).reshape(S, Q)
         # accepted = leading run of draft positions whose draft equals
         # the model's emission ONE POSITION EARLIER (emitted[j] is the
         # model's choice for the token AT input position j+1)
@@ -695,6 +758,7 @@ class RaggedInferenceModel:
     def _mixed_sample_step_impl(self, params, kv, d_tok, d_ql, d_sp,
                                 d_pt, p_tok, p_ql, p_sp, p_pt, rng,
                                 temps, top_ks, top_ps,
+                                row_uids=None, row_pos=None,
                                 fresh_p: bool = False,
                                 greedy_only: bool = False):
         """Two-segment fused step: decode [S_d, 1] then prefill [S_p, Q]
@@ -707,11 +771,8 @@ class RaggedInferenceModel:
         logits_p, kv = self._step_impl(params, kv, p_tok, p_ql, p_sp,
                                        p_pt, fresh=fresh_p)
         logits = jnp.concatenate([logits_d, logits_p], axis=0)
-        if greedy_only:
-            tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            from .sampling import sample_dynamic
-            tokens = sample_dynamic(logits, rng, temps, top_ks, top_ps)
+        tokens = self._sample_tokens(logits, rng, temps, top_ks, top_ps,
+                                     row_uids, row_pos, greedy_only)
         # pad the token vector to the slot bucket: S_d + S_p is an
         # arbitrary sum, and a later chained step keys on the EXACT
         # prev-token length — bucketing here collapses the chain-key

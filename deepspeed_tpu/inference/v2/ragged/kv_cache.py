@@ -88,16 +88,35 @@ class BlockedKVCache:
         if len(pages):
             self.allocator.free(pages)
 
+    @staticmethod
+    def _transfer_bucket(n: int) -> int:
+        """Page-transfer ops pad their index vector to a power-of-two
+        bucket (padding rows target the null page, whose contents are
+        garbage by contract) so the gather/scatter programs compile
+        once per BUCKET instead of once per distinct page count — the
+        disagg handoff (ISSUE 13) runs one export/import per scheduler
+        sweep, and an XLA compile per novel size would dominate the
+        transfer it exists to speed up.  Snapshot and preemption
+        offload/restore ride the same fix."""
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
     # -- sequence offload/restore (reference kv_cache.py:166-184) --------
     def read_pages(self, pages) -> "np.ndarray":
         """Copy the given pages to host WITHOUT freeing them — the
         page-transfer export half shared by serving snapshots (ISSUE 8)
-        and, by design, the future replica-to-replica migration path
-        (ROADMAP item 4).  Returns the host blob [L, n, page, 2, K, D];
-        ``restore_pages`` is the matching import."""
+        and the disagg handoff (ISSUE 13).  Returns the host blob
+        [L, n, page, 2, K, D]; ``restore_pages`` is the matching
+        import."""
         import numpy as np
-        idx = jnp.asarray(list(pages), jnp.int32)
-        return np.asarray(self.data[:, idx])
+        pages = list(pages)
+        n = len(pages)
+        idx = np.zeros(self._transfer_bucket(n), np.int32)
+        idx[:n] = pages
+        blob = np.asarray(self.data[:, jnp.asarray(idx)])
+        return blob[:, :n]
 
     def offload_pages(self, pages) -> "np.ndarray":
         """Copy the given pages to HOST memory and free them on device —
@@ -113,11 +132,19 @@ class BlockedKVCache:
         new page ids (the sequence's table must be updated to them).
         The scatter DONATES the cache buffer — an out-of-place update
         would transiently need ~2x the KV pool, an OOM exactly in the
-        memory-pressure situation preemption exists to relieve."""
+        memory-pressure situation preemption exists to relieve.
+        Padding columns (bucketed shape) scatter zeros into the null
+        page, which holds garbage by contract."""
         import numpy as np
         n = blob.shape[1]
         pages = self.reserve(n)
-        idx = jnp.asarray(pages, jnp.int32)
-        self.data = _scatter_pages(self.data, idx,
+        b = self._transfer_bucket(n)
+        idx = np.zeros(b, np.int32)
+        idx[:n] = pages
+        if b != n:
+            pad = np.zeros(blob.shape[:1] + (b - n,) + blob.shape[2:],
+                           dtype=np.asarray(blob).dtype)
+            blob = np.concatenate([np.asarray(blob), pad], axis=1)
+        self.data = _scatter_pages(self.data, jnp.asarray(idx),
                                    jnp.asarray(blob, self.cfg.dtype))
         return np.asarray(pages)

@@ -301,16 +301,34 @@ class StateManager:
     # numpy arrays), with page ids remapped on import so the receiving
     # pool's layout is free to differ.
 
-    def export_state(self) -> tuple:
+    def export_state(self, seq_ids: Optional[List[int]] = None) -> tuple:
         """Serialize every tracked sequence, the prefix-cache index, and
         the referenced KV page CONTENTS (each distinct device page
         written once — sharing and refcounts are reconstructed from the
         block tables on import).  Requires drained state (no in-flight
-        tokens).  Returns ``(meta, arrays)``."""
+        tokens).  Returns ``(meta, arrays)``.
+
+        With ``seq_ids`` (ISSUE 13, the disaggregation handoff) the
+        export is SELECTIVE: only the listed sequences, only the pages
+        their block tables reference (full committed prefix pages plus
+        the private partial tail page), and only the prefix-index
+        entries bound to those pages — the digest chain is what lets
+        the importing pool dedup already-held shared prefixes instead
+        of streaming them again.  Parked cache pages outside the listed
+        sequences do NOT ride along, and the resulting bundle is marked
+        ``selective`` so ``import_state`` takes the merge path."""
         from ..snapshot import SnapshotError
+        if seq_ids is not None:
+            missing = [u for u in seq_ids if int(u) not in self._seqs]
+            if missing:
+                raise SnapshotError(
+                    f"selective export of untracked sequences {missing}")
+            export_seqs = {int(u): self._seqs[int(u)] for u in seq_ids}
+        else:
+            export_seqs = self._seqs
         page_order: List[int] = []
         seen = set()
-        for sd in self._seqs.values():
+        for sd in export_seqs.values():
             if sd.in_flight_tokens:
                 raise SnapshotError(
                     f"sequence {sd.uid} has {sd.in_flight_tokens} "
@@ -320,17 +338,23 @@ class StateManager:
                     seen.add(p)
                     page_order.append(int(p))
         prefix_entries = []
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and seq_ids is None:
             prefix_entries = self.prefix_cache.export_entries()
             for _, p in prefix_entries:
                 if p not in seen:       # parked (cache-retained) page
                     seen.add(p)
                     page_order.append(int(p))
+        elif self.prefix_cache is not None:
+            # selective: only entries whose page the bundle carries —
+            # the importer's dedup and re-indexing hooks
+            prefix_entries = [(d, p) for d, p
+                              in self.prefix_cache.export_entries()
+                              if p in seen]
         arrays: Dict[str, np.ndarray] = {}
         if page_order:
             arrays["page_blob"] = self.kv_cache.read_pages(page_order)
         seqs = []
-        for uid, sd in self._seqs.items():
+        for uid, sd in export_seqs.items():
             m = {"uid": int(uid), "seen_tokens": int(sd.seen_tokens),
                  "pages": [int(p) for p in sd.pages],
                  "live_slots": [int(i) for i in sd.live_slots],
@@ -354,25 +378,12 @@ class StateManager:
             "sequences": seqs,
             "prefix": [[d.hex(), int(p)] for d, p in prefix_entries],
         }
+        if seq_ids is not None:
+            meta["selective"] = True
         return meta, arrays
 
-    def import_state(self, meta: dict, arrays: Dict[str, np.ndarray]
-                     ) -> None:
-        """Reconstruct exported state into THIS (empty) manager: fresh
-        device pages are allocated and scattered from the blob, block
-        tables are remapped onto them with the original refcounts
-        (shared prefix pages shared again, cache-retained pages parked
-        again), and the prefix index is rebuilt in its original LRU
-        order.  Raises :class:`SnapshotError` on geometry mismatch,
-        non-empty state, or a pool too small for the bundle."""
+    def _check_kv_meta(self, meta: dict) -> None:
         from ..snapshot import SnapshotError
-        alloc = self.kv_cache.allocator
-        if self._seqs or alloc.live_pages or alloc.parked_pages:
-            raise SnapshotError(
-                "import_state requires an empty state manager "
-                f"({len(self._seqs)} tracked sequences, "
-                f"{alloc.live_pages} live / {alloc.parked_pages} parked "
-                "pages)")
         kv, cfg = meta["kv"], self.kv_config
         ours = {"num_layers": cfg.num_layers, "kv_heads": cfg.kv_heads,
                 "head_dim": cfg.head_dim, "page_size": cfg.page_size,
@@ -380,6 +391,35 @@ class StateManager:
         if kv != ours:
             raise SnapshotError(
                 f"KV geometry mismatch: bundle {kv} vs engine {ours}")
+
+    def import_state(self, meta: dict, arrays: Dict[str, np.ndarray]
+                     ) -> Optional[dict]:
+        """Reconstruct exported state into THIS (empty) manager: fresh
+        device pages are allocated and scattered from the blob, block
+        tables are remapped onto them with the original refcounts
+        (shared prefix pages shared again, cache-retained pages parked
+        again), and the prefix index is rebuilt in its original LRU
+        order.  Raises :class:`SnapshotError` on geometry mismatch,
+        non-empty state, or a pool too small for the bundle.
+
+        A ``selective`` bundle (``export_state(seq_ids=...)``) instead
+        MERGES into this possibly-busy manager — the disaggregation
+        handoff path — and returns ``{"pages_streamed",
+        "pages_shared"}`` (pages whose chain digest this manager's
+        prefix cache already held attach by reference instead of being
+        scattered from the blob: prefix sharing survives the pool
+        boundary)."""
+        from ..snapshot import SnapshotError
+        if meta.get("selective"):
+            return self._import_selective(meta, arrays)
+        alloc = self.kv_cache.allocator
+        if self._seqs or alloc.live_pages or alloc.parked_pages:
+            raise SnapshotError(
+                "import_state requires an empty state manager "
+                f"({len(self._seqs)} tracked sequences, "
+                f"{alloc.live_pages} live / {alloc.parked_pages} parked "
+                "pages)")
+        self._check_kv_meta(meta)
         if bool(meta.get("prefix_caching")) != \
                 (self.prefix_cache is not None):
             raise SnapshotError(
@@ -439,6 +479,131 @@ class StateManager:
                     raise SnapshotError(
                         f"prefix index references unexported page {p}")
                 self.prefix_cache.insert(bytes.fromhex(d_hex), newp)
+        return None
+
+    def _import_selective(self, meta: dict,
+                          arrays: Dict[str, np.ndarray]) -> dict:
+        """Merge one selective (handoff) bundle into this possibly-busy
+        manager (ISSUE 13).  Phases are ordered so a refused import
+        leaves no mutation behind: (1) validate uids/geometry and
+        compute the digest-dedup mapping, (2) budget-check the pages
+        that must actually stream, (3) attach dedup pages by reference
+        (they leave the eviction pool BEFORE ensure_free runs), evict
+        for and scatter the streamed subset, (4) rebuild descriptors /
+        host blobs and re-index the digest chain so the NEXT handoff
+        sharing this prefix dedups too."""
+        from ..snapshot import SnapshotError
+        self._check_kv_meta(meta)
+        alloc = self.kv_cache.allocator
+        for m in meta["sequences"]:
+            if int(m["uid"]) in self._seqs:
+                raise SnapshotError(
+                    f"selective import: uid {m['uid']} already tracked")
+        if (len(self._seqs) + len(meta["sequences"])
+                > self.max_tracked_sequences):
+            # retryable backpressure, like the page-budget refusal
+            # below: the importing pool frees tracked slots as its
+            # requests finish
+            raise KVAllocationError(
+                f"handoff import would track "
+                f"{len(self._seqs) + len(meta['sequences'])} sequences "
+                f"(limit {self.max_tracked_sequences}) — retry after "
+                "the pool drains")
+        old_ids = [int(p) for p in meta["page_ids"]]
+        blob = arrays.get("page_blob")
+        if old_ids and (blob is None or blob.shape[1] != len(old_ids)):
+            raise SnapshotError(
+                "page blob missing or inconsistent with page_ids")
+        # digest-keyed dedup: a full prefix page whose cumulative chain
+        # digest this manager's cache already indexes holds exactly the
+        # same KV (same tokens, same weights across the disagg pools,
+        # 128-bit chained blake2b) — attach the local page instead of
+        # streaming the exported copy
+        digest_of = {int(p): bytes.fromhex(d) for d, p in meta["prefix"]}
+        mapping = {NULL_PAGE: NULL_PAGE}
+        dedup: Dict[int, int] = {}
+        stream: List[int] = []
+        for old in old_ids:
+            local = None
+            d = digest_of.get(old)
+            if d is not None and self.prefix_cache is not None:
+                local = self.prefix_cache.lookup(d)
+                if local is not None and not alloc.is_allocated(local):
+                    local = None    # defensive: never attach a freed page
+            if local is not None:
+                dedup[old] = int(local)
+                mapping[old] = int(local)
+            else:
+                stream.append(old)
+        # budget check BEFORE any mutation (the refusal must stay
+        # retryable): parked pages that are about to be attached as
+        # dedup targets become LIVE below, so they cannot also be
+        # evicted to make room for the streamed pages — subtract them
+        # from the schedulable count or a refused allocation would
+        # land after the add_ref and leak phantom references
+        parked_dedup = sum(1 for local in dedup.values()
+                           if alloc.is_parked(local))
+        available = alloc.free_pages + alloc.parked_pages - parked_dedup
+        if len(stream) > available:
+            raise KVAllocationError(
+                f"handoff import needs {len(stream)} streamed pages, "
+                f"pool has {available} schedulable — retry after "
+                "the decode pool drains")
+        # true refcounts per exported page = appearances in the
+        # imported block tables (selective bundles carry no parked
+        # pages, so every exported page is referenced at least once)
+        refs = Counter()
+        for m in meta["sequences"]:
+            for p in m["pages"]:
+                if p != NULL_PAGE:
+                    refs[int(p)] += 1
+        for old, local in dedup.items():
+            n = refs.get(old, 0)
+            if n:
+                alloc.add_ref([local] * n)
+        if stream:
+            self.ensure_free(len(stream))
+            col = {p: i for i, p in enumerate(old_ids)}
+            sub = np.ascontiguousarray(
+                blob[:, [col[p] for p in stream]])
+            new = self.kv_cache.restore_pages(sub)   # refcount 1 each
+            for old, newp in zip(stream, new):
+                mapping[old] = int(newp)
+                n = refs.get(old, 0)
+                if n < 1:
+                    raise SnapshotError(
+                        f"selective bundle streams unreferenced page "
+                        f"{old}")
+                if n > 1:
+                    alloc.add_ref([int(newp)] * (n - 1))
+        for m in meta["sequences"]:
+            uid = int(m["uid"])
+            try:
+                pages = [mapping[int(p)] for p in m["pages"]]
+            except KeyError as e:
+                raise SnapshotError(
+                    f"sequence {uid} references unexported page {e}")
+            sd = SequenceDescriptor(
+                uid=uid, seen_tokens=int(m["seen_tokens"]), pages=pages,
+                live_slots=[int(i) for i in m["live_slots"]],
+                indexed_pages=int(m["indexed_pages"]),
+                last_digest=bytes.fromhex(m["last_digest"]))
+            if m["has_prompt"]:
+                sd.prompt_tokens = np.asarray(arrays[f"prompt_{uid}"],
+                                              np.int32)
+            if m["has_blob"]:
+                sd.host_blob = arrays[f"hostblob_{uid}"]
+                self._offload_blobs += 1
+                self._offload_bytes += sd.host_blob.nbytes
+            self._seqs[uid] = sd
+        if self.prefix_cache is not None:
+            for d_hex, p in meta["prefix"]:
+                newp = mapping.get(int(p))
+                if newp is not None:
+                    self.prefix_cache.insert(bytes.fromhex(d_hex),
+                                             int(newp))
+        return {"pages_streamed": len(stream),
+                "pages_shared": len(dedup)}
 
     # -- KV accounting ------------------------------------------------------
     def pages_needed(self, sd: SequenceDescriptor, n_new_tokens: int) -> int:

@@ -97,6 +97,16 @@ class PrefixCache:
         self._by_page[int(page)] = digest
         return True
 
+    def lookup(self, digest: bytes):
+        """Page bound to ``digest`` (LRU-touched), else None — the
+        importer-side dedup probe of the disaggregation handoff
+        (ISSUE 13): a matching cumulative digest means this pool
+        already holds that exact token prefix's KV page."""
+        page = self._entries.get(digest)
+        if page is not None:
+            self._entries.move_to_end(digest)
+        return page
+
     def contains_page(self, page: int) -> bool:
         return int(page) in self._by_page
 

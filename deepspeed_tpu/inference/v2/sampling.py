@@ -24,20 +24,13 @@ class SamplingParams:
     stop_token: Optional[int] = None
 
 
-def sample_dynamic(logits: jax.Array, rng: jax.Array,
-                   temperature: jax.Array, top_k: jax.Array,
-                   top_p: jax.Array) -> jax.Array:
-    """Per-row dynamic sampling: logits [S, V] + per-row params -> [S].
-
-    The on-device half of the fused serving step: temperature/top_k/top_p
-    are DYNAMIC [S] inputs, so one compiled program covers every
-    params mix in a ragged batch — no host-side grouping, no per-group
-    kernels, and only the int32 tokens cross device->host.  Semantics
-    match ``sample`` row-for-row: temperature <= 0 selects argmax
-    (top_k/top_p are no-ops at temp 0), top_k <= 0 disables the k filter,
-    top_p >= 1 disables the nucleus filter, and the nucleus cutoff is
-    computed over the top-k-filtered distribution like the grouped path.
-    """
+def _filter_rows(logits: jax.Array, temperature: jax.Array,
+                 top_k: jax.Array, top_p: jax.Array):
+    """The ONE per-row temperature/top-k/top-p filter behind both the
+    step-keyed and the row-keyed samplers — they may only differ in
+    where the categorical draw's randomness comes from, never in the
+    distribution it draws from.  Returns (masked logits, greedy
+    argmax, is_greedy mask)."""
     S, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     is_greedy = temperature <= 0.0
@@ -60,8 +53,58 @@ def sample_dynamic(logits: jax.Array, rng: jax.Array,
     cutoff_idx = jnp.minimum(jnp.sum(cum < top_p[:, None], axis=-1), V - 1)
     cutoff = jnp.take_along_axis(sorted_f, cutoff_idx[:, None], axis=-1)
     l = jnp.where((top_p < 1.0)[:, None] & (l < cutoff), -jnp.inf, l)
+    return l, greedy, is_greedy
+
+
+def sample_dynamic(logits: jax.Array, rng: jax.Array,
+                   temperature: jax.Array, top_k: jax.Array,
+                   top_p: jax.Array) -> jax.Array:
+    """Per-row dynamic sampling: logits [S, V] + per-row params -> [S].
+
+    The on-device half of the fused serving step: temperature/top_k/top_p
+    are DYNAMIC [S] inputs, so one compiled program covers every
+    params mix in a ragged batch — no host-side grouping, no per-group
+    kernels, and only the int32 tokens cross device->host.  Semantics
+    match ``sample`` row-for-row: temperature <= 0 selects argmax
+    (top_k/top_p are no-ops at temp 0), top_k <= 0 disables the k filter,
+    top_p >= 1 disables the nucleus filter, and the nucleus cutoff is
+    computed over the top-k-filtered distribution like the grouped path.
+    """
+    l, greedy, is_greedy = _filter_rows(logits, temperature, top_k,
+                                        top_p)
     sampled = jax.random.categorical(rng, l, axis=-1).astype(jnp.int32)
     return jnp.where(is_greedy, greedy, sampled)
+
+
+def derive_row_keys(base: jax.Array, row_uids: jax.Array,
+                    row_pos: jax.Array) -> jax.Array:
+    """Schedule-invariant per-row RNG (ISSUE 13 keyed sampling): the
+    key for one sampled token is a pure function of (base key, request
+    uid, generation position), so the same request draws the same token
+    stream no matter which step, batch composition, or ENGINE it is
+    sampled in — the property a disaggregated prefill/decode handoff
+    (or any migration) needs for sampled continuations to be tokenwise
+    identical to the fused single-engine run.  ``base`` is never split;
+    ``row_uids``/``row_pos`` are [S] int32.  Returns a [S] batched key
+    array."""
+    def one(u, p):
+        return jax.random.fold_in(jax.random.fold_in(base, u), p)
+    return jax.vmap(one)(row_uids, row_pos)
+
+
+def sample_keyed(logits: jax.Array, row_keys: jax.Array,
+                 temperature: jax.Array, top_k: jax.Array,
+                 top_p: jax.Array) -> jax.Array:
+    """``sample_dynamic`` with one independent key PER ROW ([S] batched
+    key array from :func:`derive_row_keys`) instead of one step key for
+    the whole batch.  Filtering is the shared ``_filter_rows`` —
+    identical row-for-row by construction; only the categorical draw's
+    randomness source differs."""
+    l, greedy, is_greedy = _filter_rows(logits, temperature, top_k,
+                                        top_p)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row))(row_keys, l)
+    return jnp.where(is_greedy, greedy, sampled.astype(jnp.int32))
 
 
 @functools.partial(jax.jit, static_argnames=("temperature", "top_k", "top_p"))

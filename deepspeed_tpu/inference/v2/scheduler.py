@@ -126,6 +126,10 @@ class RequestError:
       (drain-for-snapshot / shutdown); resubmit to the restored replica
     - ``"migrated"`` — the preemption grace budget expired before a
       snapshot could be written; partial tokens kept (ISSUE 8)
+    - ``"misrouted"`` — the request does not fit this scheduler's
+      disaggregated role (ISSUE 13): a fresh submit to a decode-only
+      pool, or a multi-token submit to a prefill-only pool with no
+      handoff sink — rejected immediately so it can never sit forever
 
     ``tokens`` holds whatever the request generated before
     termination."""
@@ -191,7 +195,7 @@ class FastGenScheduler:
     def __init__(self, engine: InferenceEngineV2,
                  token_budget: Optional[int] = None,
                  rng: Optional[jax.Array] = None,
-                 serving=None):
+                 serving=None, role: Optional[str] = None):
         self._engine = engine
         self._budget = (token_budget or
                         engine._config.state_manager.max_ragged_batch_size)
@@ -199,6 +203,31 @@ class FastGenScheduler:
         self._serving = sv
         self._fused_cfg = bool(sv.fused_step and sv.on_device_sampling)
         self._async_cfg = bool(self._fused_cfg and sv.async_scheduling)
+        # -- disaggregated pools (ISSUE 13) ---------------------------
+        self._role = str(role if role is not None
+                         else getattr(sv, "role", "both") or "both")
+        if self._role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"unknown scheduler role {self._role!r} "
+                "(expected both|prefill|decode)")
+        if self._role == "prefill":
+            # a prefill pool never steady-state decodes: the async
+            # chain (and speculation below) are decode-pool machinery,
+            # and every request leaves after its FIRST token
+            self._async_cfg = False
+        #: requests that finished prefill + first token on a prefill
+        #: role scheduler, awaiting collection by the DisaggPool
+        self._handoff_ready: Dict[int, Request] = {}
+        #: a DisaggPool registered itself as the handoff consumer; a
+        #: prefill role scheduler WITHOUT one rejects multi-token
+        #: requests (they could never finish here — satellite: a
+        #: misrouted request must not sit forever)
+        self._handoff_sink = False
+        #: keyed (schedule-invariant) sampling is an ENGINE-build fact:
+        #: the compiled programs' signatures carry the per-row (uid,
+        #: position) inputs, so follow the model, not the serving view
+        self._keyed = bool(getattr(engine.model, "keyed_sampling",
+                                   False))
         self._warned_strict_fallback = False
         self._inflight: Optional[_Inflight] = None
         self._pending: List[Request] = []     # waiting for first prefill
@@ -259,7 +288,8 @@ class FastGenScheduler:
         self._tseries = get_timeseries()
         self._bind_backlog_gauges()
         # -- speculative decoding (ISSUE 10) --------------------------
-        self._spec_cfg = bool(getattr(sv, "speculative", False))
+        self._spec_cfg = bool(getattr(sv, "speculative", False)
+                              and self._role != "prefill")
         self._spec_max_draft = max(
             int(getattr(sv, "spec_max_draft", 3) or 0), 0)
         self._drafter = (NgramDrafter(
@@ -383,6 +413,23 @@ class FastGenScheduler:
                 req, "closing",
                 "scheduler is draining for snapshot/shutdown — "
                 "resubmit to the restored replica")
+        # role admission (ISSUE 13): a request the role can never
+        # finish is rejected with a structured verdict instead of
+        # sitting in a queue nothing will ever drain
+        if self._role == "decode":
+            return self._reject_submit(
+                req, "misrouted",
+                "decode-only scheduler: fresh requests need prefill — "
+                "submit to the prefill pool (this engine admits "
+                "handoff imports only)")
+        if self._role == "prefill" and not self._handoff_sink \
+                and req.params.max_new_tokens > 1:
+            return self._reject_submit(
+                req, "misrouted",
+                "prefill-only scheduler with no handoff sink attached: "
+                f"max_new_tokens={req.params.max_new_tokens} could "
+                "never complete here (only the first token is produced "
+                "on the prefill pool)")
         ttl = ttl_s if ttl_s is not None else (self._default_ttl_s
                                                or None)
         if ttl:
@@ -430,6 +477,7 @@ class FastGenScheduler:
         with an error record that is returned but not stored (storing
         would clobber the live request's eventual verdict)."""
         live = (req.uid in self._running or req.uid in self._preempted
+                or req.uid in self._handoff_ready
                 or any(r.uid == req.uid for r in self._pending))
         if live:
             err = RequestError(uid=req.uid, code=code, message=message)
@@ -452,6 +500,7 @@ class FastGenScheduler:
         self._pending = [r for r in self._pending if r.uid != req.uid]
         self._running.pop(req.uid, None)
         self._preempted.pop(req.uid, None)
+        self._handoff_ready.pop(req.uid, None)
         if self._drafter is not None:
             self._drafter.drop(req.uid)
         if self._engine.state_manager.get_sequence(req.uid) is not None:
@@ -467,6 +516,8 @@ class FastGenScheduler:
             # "closing" IS admission control: the valve is the
             # scheduler's lifecycle instead of queue depth
             tm.FASTGEN_SHED.inc()
+        elif code == "misrouted":
+            tm.DISAGG_MISROUTED.inc()
         elif code == "expired":
             tm.FASTGEN_EXPIRED.inc()
         elif code == "migrated":
@@ -489,7 +540,8 @@ class FastGenScheduler:
         now = time.monotonic()
         expired = [r for r in (list(self._pending)
                                + list(self._running.values())
-                               + list(self._preempted.values()))
+                               + list(self._preempted.values())
+                               + list(self._handoff_ready.values()))
                    if r.deadline is not None and now >= r.deadline]
         for req in expired:
             self._fail_request(
@@ -555,8 +607,11 @@ class FastGenScheduler:
     def _next_key(self, greedy_only: bool) -> jax.Array:
         """Greedy-only steps never consume RNG state (argmax needs no
         randomness — splitting a key per step would make greedy decode
-        depend on how many steps ran before it)."""
-        if greedy_only:
+        depend on how many steps ran before it).  Keyed sampling
+        (ISSUE 13) never splits either: the base key is the fixed root
+        every per-(uid, position) row key derives from, so the stream
+        is independent of step count by construction."""
+        if greedy_only or self._keyed:
             return self._rng
         self._rng, key = jax.random.split(self._rng)
         return key
@@ -678,9 +733,14 @@ class FastGenScheduler:
         gather = [r for _, r, _ in rows]
         params = [req.params for _, _, req in rows]
         greedy_only = all(p.temperature <= 0.0 for p in params)
+        # keyed sampling: the chained step samples the token AFTER the
+        # in-flight one (generation index len(generated) + 1 — the
+        # in-flight token, not yet drained, is index len(generated))
+        row_pos = ([len(req.generated) + 1 for _, _, req in rows]
+                   if self._keyed else None)
         toks = self._engine.step_decode_chained(
             uids, self._inflight.tokens_dev, gather, params,
-            self._next_key(greedy_only))
+            self._next_key(greedy_only), row_pos=row_pos)
         self.last_step_scheduled = len(uids)
         return _Inflight(tokens_dev=toks,
                          rows=[(u, i, req)
@@ -823,10 +883,14 @@ class FastGenScheduler:
         toks = [t for _, _, t, _ in rows]
         params = [req.params for _, req, _, _ in rows]
         greedy_only = all(p.temperature <= 0.0 for p in params)
+        # keyed: position j of a spec row emits generation index
+        # len(generated) + j (the device folds per position)
+        row_pos = ([len(req.generated) for _, req, _, _ in rows]
+                   if self._keyed else None)
         with trace_span("fastgen.dispatch.spec"):
             out_dev = self._engine.step_spec(
                 uids, toks, params, self._next_key(greedy_only),
-                min_q=1 + self._spec_max_draft)
+                min_q=1 + self._spec_max_draft, row_pos=row_pos)
         self.last_step_scheduled = len(uids)
         av = np.asarray(out_dev)            # the ONLY d2h: [S, 2] int32
         serving_counters.record_d2h(av.nbytes)
@@ -916,6 +980,8 @@ class FastGenScheduler:
             # before the exception leaves the step loop; never masks it
             get_flight_recorder().on_crash("fastgen.step", e)
             raise
+        if self._role == "prefill" and self._running:
+            self._sweep_handoff_ready()
         if self._kv_debug:
             self._engine.state_manager.check_invariants()
         if self._tseries.active:
@@ -1166,11 +1232,16 @@ class FastGenScheduler:
             # greedy_only above uses the same sampled-rows-only rule
             row_params = [r.params if r.prefill_remaining == 0
                           else SamplingParams() for r in reqs]
+            # keyed: a sampled row emits generation index
+            # len(generated) (mid-prefill rows' draws are ignored)
+            row_pos = ([len(r.generated) for r in reqs]
+                       if self._keyed else None)
             try:
                 with trace_span("fastgen.dispatch.fused"):
                     toks, rowmap = self._engine.step_sample(
                         uids, tokens, row_params,
-                        self._next_key(greedy_only), do_checks=False)
+                        self._next_key(greedy_only), do_checks=False,
+                        row_pos=row_pos)
             except KVAllocationError as e:
                 self._degrade_oom(e, advances, new_admits)
                 return out_prev
@@ -1205,6 +1276,21 @@ class FastGenScheduler:
                 groups.setdefault(_group_key(reqs[i].params), []).append(i)
             new_tokens: Dict[int, int] = {}
             for (temp, top_k, top_p), idxs in groups.items():
+                if self._keyed and temp > 0.0:
+                    # schedule-invariant escape-hatch sampling: one
+                    # folded (uid, position) key per row — bit-equal
+                    # to the fused keyed path's on-device derivation
+                    for i in idxs:
+                        req = reqs[i]
+                        key = jax.random.fold_in(
+                            jax.random.fold_in(self._rng, int(req.uid)),
+                            len(req.generated))
+                        t = np.asarray(sample(
+                            logits[np.asarray([i])], key,
+                            temperature=temp, top_k=top_k, top_p=top_p))
+                        serving_counters.record_d2h(t.nbytes)
+                        new_tokens[i] = int(t[0])
+                    continue
                 key = self._next_key(greedy_only=temp <= 0.0)
                 toks = np.asarray(sample(logits[np.asarray(idxs)], key,
                                          temperature=temp, top_k=top_k,
@@ -1220,20 +1306,158 @@ class FastGenScheduler:
                 self._finish_request(req)
         return out
 
+    # -- disaggregated handoff (ISSUE 13) ------------------------------------
+    @property
+    def role(self) -> str:
+        return self._role
+
+    @property
+    def handoff_backlog(self) -> int:
+        """Requests awaiting collection by the DisaggPool (prefill
+        role only; always 0 elsewhere)."""
+        return len(self._handoff_ready)
+
+    def enable_handoff_sink(self) -> None:
+        """Register a handoff consumer (the DisaggPool): a prefill
+        role scheduler then admits multi-token requests, trusting the
+        sink to stream them onward after their first token."""
+        self._handoff_sink = True
+
+    def handoff_ready_uids(self) -> List[int]:
+        return list(self._handoff_ready)
+
+    def _sweep_handoff_ready(self) -> None:
+        """Prefill role: a running request whose prefill is complete
+        and whose FIRST token is host-delivered (TTFT already served —
+        the transfer never gates it) leaves the scheduling sets and
+        parks as handoff-ready.  Its engine sequence stays live until
+        ``complete_handoff``/``_fail_request``."""
+        for uid, req in list(self._running.items()):
+            if req.done or req.prefill_remaining > 0 or not req.generated:
+                continue
+            self._running.pop(uid)
+            self._handoff_ready[uid] = req
+            get_flight_recorder().record(
+                "disagg.handoff_ready", uid=uid,
+                tokens=len(req.generated))
+
+    def export_handoff(self, uids: Sequence[int]) -> dict:
+        """One handoff bundle for handoff-ready ``uids``: the
+        sequences' committed KV pages through the selective
+        ``export_state`` seam (each distinct page once; full prefix
+        pages ride with their chain digests so the importer can dedup
+        against its own prefix cache) plus each request's residual
+        state — prompt incl. the partial-page tail tokens, committed
+        tokens, sampling params, remaining TTL/token budget, spec
+        counters.  Non-destructive: the requests stay parked here
+        until :meth:`complete_handoff` (import succeeded) or
+        :meth:`_fail_request`."""
+        missing = [u for u in uids if u not in self._handoff_ready]
+        if missing:
+            raise ValueError(
+                f"export_handoff of non-handoff-ready uids {missing}")
+        now = time.monotonic()
+        eng_meta, arrays = self._engine.state_manager.export_state(
+            seq_ids=list(uids))
+        meta = {
+            "version": SNAPSHOT_VERSION,
+            "handoff": True,
+            "requests": [self._serialize_request(self._handoff_ready[u],
+                                                 now) for u in uids],
+            "engine": eng_meta,
+        }
+        return {"meta": meta, "arrays": arrays}
+
+    def complete_handoff(self, uids: Sequence[int]) -> None:
+        """The bundle landed on the decode pool: flush the local
+        sequences (their full prefix pages PARK in this pool's prefix
+        cache, so the NEXT same-prefix prompt still prefills only the
+        suffix) and drop the parked requests — their remaining
+        delivery happens on the importing scheduler."""
+        for u in uids:
+            req = self._handoff_ready.pop(u, None)
+            if req is None:
+                continue
+            if self._drafter is not None:
+                self._drafter.drop(u)
+            if self._engine.state_manager.get_sequence(u) is not None:
+                self._engine.flush(u)
+
+    def import_handoff(self, bundle: dict) -> dict:
+        """Decode-side import of one handoff bundle: merge the
+        sequences and pages into the live engine (prefix sharing and
+        refcounts reconstructed; already-held shared prefixes attach
+        by digest instead of streaming) and enqueue the residual
+        requests — straight into the running set, or the preempted
+        set when the bundle carried a mid-preemption host blob.
+        Raises :class:`SnapshotError` on a non-handoff bundle / uid
+        collision / geometry mismatch and
+        :class:`~.ragged.blocked_allocator.KVAllocationError` when the
+        pool cannot hold the streamed pages yet (retryable
+        backpressure — nothing is mutated).  Returns
+        ``{"uids", "pages_streamed", "pages_shared"}``."""
+        meta, arrays = bundle["meta"], bundle["arrays"]
+        if not meta.get("handoff"):
+            raise SnapshotError(
+                "import_handoff expects a bundle from export_handoff")
+        if self._closed:
+            raise SnapshotError(
+                "import_handoff on a closed scheduler")
+        for d in meta["requests"]:
+            uid = int(d["uid"])
+            if (uid in self._running or uid in self._preempted
+                    or uid in self._handoff_ready
+                    or any(r.uid == uid for r in self._pending)):
+                raise SnapshotError(
+                    f"import_handoff: uid {uid} already live on the "
+                    "importing scheduler")
+        with trace_span("fastgen.import_handoff"):
+            stats = self._engine.state_manager.import_state(
+                meta["engine"], arrays)
+            now = time.monotonic()
+            uids: List[int] = []
+            for d in meta["requests"]:
+                req = self._restore_request(d, now)
+                sd = self._engine.state_manager.get_sequence(req.uid)
+                if sd is not None and sd.host_blob is not None:
+                    # handed off mid-preemption: resumes through the
+                    # normal restore path once the pool has room
+                    self._preempted[req.uid] = req
+                else:
+                    self._running[req.uid] = req
+                uids.append(req.uid)
+        if self._kv_debug:
+            self._engine.state_manager.check_invariants()
+        stats = dict(stats or {})
+        stats["uids"] = uids
+        return stats
+
     # -- graceful degradation (ISSUE 7) --------------------------------------
     def _preempt_largest(self) -> bool:
-        """Preempt the running sequence holding the most OFFLOADABLE
-        KV (window eviction leaves null slots and prefix-shared pages
+        """Preempt the sequence holding the most OFFLOADABLE KV
+        (window eviction leaves null slots and prefix-shared pages
         stay resident through an offload — neither frees anything, and
-        a no-op preemption would spin run_to_completion)."""
-        if not self._running:
-            return False
+        a no-op preemption would spin run_to_completion).  Handoff-
+        ready sequences (prefill role) are preferred victims: they
+        hold pages while doing no work, and the handoff path carries
+        their host blob to the decode pool (mid-preemption handoff)."""
 
         def live_pages(u):
             state = self._engine.state_manager
             sd = state.get_sequence(u)
             return len(state.offloadable_slots(sd)) if sd else 0
 
+        if self._handoff_ready:
+            victim = max(self._handoff_ready, key=live_pages)
+            if live_pages(victim) > 0:
+                with trace_span("fastgen.preempt"):
+                    self._engine.offload_sequence(victim)
+                get_flight_recorder().record("request.preempt",
+                                             uid=victim, handoff=True)
+                self._preempted_this_step = True
+                return True
+        if not self._running:
+            return False
         victim = max(self._running, key=live_pages)
         if live_pages(victim) <= 0:
             return False
@@ -1415,6 +1639,10 @@ class FastGenScheduler:
                                 for r in self._running.values()],
                     "preempted": [self._serialize_request(r, now)
                                   for r in self._preempted.values()],
+                    # prefill role (ISSUE 13): awaiting collection
+                    "handoff_ready": [
+                        self._serialize_request(r, now)
+                        for r in self._handoff_ready.values()],
                 },
                 "counters": {
                     "step_ordinal": int(self._step_ordinal),
@@ -1460,6 +1688,7 @@ class FastGenScheduler:
                         f"unsupported snapshot version "
                         f"{meta.get('version')!r}")
             if (self._pending or self._running or self._preempted
+                    or self._handoff_ready
                     or self._inflight is not None or self._closed):
                 raise SnapshotError(
                     "restore requires a fresh scheduler (this one has "
@@ -1478,6 +1707,9 @@ class FastGenScheduler:
             self._preempted = {int(d["uid"]):
                                self._restore_request(d, now)
                                for d in reqs["preempted"]}
+            self._handoff_ready = {
+                int(d["uid"]): self._restore_request(d, now)
+                for d in reqs.get("handoff_ready", [])}
             c = meta["counters"]
             self._step_ordinal = int(c["step_ordinal"])
             self.last_step_scheduled = int(c["last_step_scheduled"])
@@ -1535,7 +1767,8 @@ class FastGenScheduler:
                 "drain_and_snapshot: grace budget %.2fs expired before "
                 "a snapshot could be written", grace)
         live = (list(self._pending) + list(self._running.values())
-                + list(self._preempted.values()))
+                + list(self._preempted.values())
+                + list(self._handoff_ready.values()))
         for req in live:
             self._fail_request(
                 req, "migrated",

@@ -489,6 +489,15 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
     spec_max_draft: int = 3
     #: shortest trailing n-gram the prompt-lookup drafter matches on
     spec_ngram_min: int = 2
+    # -- disaggregated prefill/decode serving (ISSUE 13) ---------------
+    #: scheduler role: "both" | "prefill" | "decode" — prefill-only
+    #: engines run prompt chunks + the first token and park requests
+    #: as handoff-ready; decode-only engines admit handoff imports
+    #: only (plain submits rejected with code="misrouted")
+    role: str = "both"
+    #: schedule-invariant sampling: per-(uid, position) derived RNG so
+    #: sampled output survives handoff/migration tokenwise identical
+    keyed_sampling: bool = False
 
     def to_v2_dict(self) -> Dict[str, Any]:
         """The ``serving_optimization`` dict the inference-v2 config
@@ -505,7 +514,9 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
                 "snapshot_path": self.snapshot_path,
                 "speculative": self.speculative,
                 "spec_max_draft": self.spec_max_draft,
-                "spec_ngram_min": self.spec_ngram_min}
+                "spec_ngram_min": self.spec_ngram_min,
+                "role": self.role,
+                "keyed_sampling": self.keyed_sampling}
 
 
 class TPUConfig(DeepSpeedConfigModel):

@@ -1,10 +1,13 @@
 """Replica-pool serving (ISSUE 12, ROADMAP item 1): N FastGenScheduler
 replicas behind a prefix-affinity router with live migration and
-SLO-driven autoscaling."""
+SLO-driven autoscaling — plus disaggregated prefill/decode pools with
+committed-page KV streaming (ISSUE 13, ROADMAP item 2)."""
 
+from .disagg import DisaggPool
 from .pool import PoolRequest, ReplicaPool
 from .router import (POLICIES, PrefixAffinityRouter, RouteDecision,
                      fetch_remote_hints)
 
 __all__ = ["ReplicaPool", "PoolRequest", "PrefixAffinityRouter",
-           "RouteDecision", "POLICIES", "fetch_remote_hints"]
+           "RouteDecision", "POLICIES", "fetch_remote_hints",
+           "DisaggPool"]

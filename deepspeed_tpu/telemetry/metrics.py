@@ -7,7 +7,7 @@ registry against docs/DESIGN.md's metric table in tier-1.
 
 Naming convention: ``ds_<area>_<name>`` with area one of
 {serving, comm, kv, train, fastgen, chaos, fleet, slo, telemetry,
-pool};
+pool, disagg};
 counters end in ``_total``.
 """
 
@@ -256,6 +256,46 @@ POOL_REPLICA_DEATHS = registry.counter(
     "ds_pool_replica_deaths_total",
     "replicas that died abruptly (preemption/kill) and had their "
     "tracked requests resubmitted to survivors")
+
+# -- disaggregated prefill/decode serving (ISSUE 13) --------------------------
+DISAGG_HANDOFFS = registry.counter(
+    "ds_disagg_handoffs_total",
+    "sequences streamed from the prefill pool to the decode pool "
+    "(committed pages + residual request state)")
+DISAGG_HANDOFF_BYTES = registry.counter(
+    "ds_disagg_handoff_bytes_total",
+    "bytes of KV page blobs + residual arrays crossing the prefill -> "
+    "decode handoff seam")
+DISAGG_HANDOFF_MS = registry.histogram(
+    "ds_disagg_handoff_ms",
+    "wall time of one handoff batch: selective export -> merge import "
+    "-> prefill-side flush")
+DISAGG_PAGES_STREAMED = registry.counter(
+    "ds_disagg_pages_streamed_total",
+    "KV pages physically copied across the handoff seam")
+DISAGG_PAGES_SHARED = registry.counter(
+    "ds_disagg_pages_shared_total",
+    "KV pages the decode pool already held (chain-digest dedup against "
+    "its prefix cache) — attached by reference, never copied")
+DISAGG_HANDOFF_RETRY = registry.counter(
+    "ds_disagg_handoff_retry_total",
+    "handoff imports deferred by decode-pool KV backpressure")
+DISAGG_MISROUTED = registry.counter(
+    "ds_disagg_misrouted_total",
+    "requests rejected by a role-restricted scheduler's admission "
+    "(structured RequestError code=misrouted)")
+DISAGG_HANDOFF_BACKLOG = registry.gauge(
+    "ds_disagg_handoff_backlog",
+    "requests parked handoff-ready on the prefill pool awaiting "
+    "collection")
+DISAGG_PREFILL_MFU = registry.gauge(
+    "ds_disagg_prefill_mfu",
+    "prefill pool model-FLOPs utilization over its cost window (the "
+    "ISSUE 9 per-program accounting, read per pool)")
+DISAGG_DECODE_HBM_GB_S = registry.gauge(
+    "ds_disagg_decode_hbm_gb_s",
+    "decode pool HBM traffic rate (GB/s of bytes accessed) over its "
+    "cost window")
 
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(

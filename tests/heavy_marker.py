@@ -67,6 +67,9 @@ CHAOS_TESTS = frozenset([
 ])
 
 HEAVY_TESTS = frozenset([
+    "tests/test_disagg.py::TestHandoffParity::test_parity_with_staggered_arrivals_and_dedup",  # 7.1s, 3 engines (newly added)
+    "tests/test_disagg.py::TestKeyedSampling::test_schedule_invariance",  # 6.3s, 2 engines (newly added)
+    "tests/test_disagg.py::TestHandoffParity::test_threaded_serve_matches_fused",  # 6.1s, 3 engines + threads (newly added)
     "tests/test_spec_decoding.py::TestStrictSpec::test_strict_spec_lattice",  # 16.7s, full sampling+spec lattice AOT (newly added)
     "tests/test_spec_decoding.py::TestStrictSpec::test_strict_without_spec_buckets_latches_off",  # ~14s, full sampling lattice AOT (newly added)
     "tests/test_spec_decoding.py::TestSpecParity::test_mixed_workload_parity",  # 6.7s, 3 serving variants (newly added)

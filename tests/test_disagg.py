@@ -1,0 +1,586 @@
+"""Disaggregated prefill/decode pools with committed-page KV streaming
+(ISSUE 13).
+
+Covers the tentpole — the per-sequence selective export/import handoff
+that reconstructs prefix sharing on the decode side, first token on the
+prefill pool, per-role lattice shrink — plus the satellites: role
+admission (structured ``misrouted``, never a hang), ``kinds=`` lattice
+filtering with the shrink guard, keyed (schedule-invariant) sampling,
+mid-preemption handoff, prefix-cache hit-rate survival across the pool
+boundary, and KV backpressure with structured failure.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from deepspeed_tpu.inference.v2 import (
+    FastGenScheduler, InferenceEngineV2, KVCacheConfig,
+    RaggedInferenceEngineConfig, RaggedInferenceModel, SamplingParams,
+    ServingOptimizationConfig, SnapshotError, StateManagerConfig)
+from deepspeed_tpu.inference.v2.engine import (LATTICE_KINDS,
+                                               lattice_keys,
+                                               lattice_kind_of)
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import \
+    KVAllocationError
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+from deepspeed_tpu.serving import DisaggPool
+from deepspeed_tpu.telemetry import metrics as tm
+
+
+@pytest.fixture(autouse=True)
+def _kv_debug(monkeypatch):
+    """DS_KV_DEBUG=1: both pools audit the page-accounting invariants
+    after every step, so a handoff can't silently leak or double-use
+    pages on either side."""
+    monkeypatch.setenv("DS_KV_DEBUG", "1")
+
+
+_PARAMS_CACHE = {}
+
+
+def _model_parts():
+    if not _PARAMS_CACHE:
+        model_def = LlamaForCausalLM("debug", max_seq_len=256,
+                                     dtype=jnp.float32)
+        _PARAMS_CACHE["cfg"] = model_def.cfg
+        _PARAMS_CACHE["params"] = meta.unbox(
+            model_def.init_params(jax.random.key(0)))
+    return _PARAMS_CACHE["cfg"], _PARAMS_CACHE["params"]
+
+
+def _engine(serving=None, num_pages=96, max_seqs=8, max_batch=256):
+    cfg, params = _model_parts()
+    kv_cfg = KVCacheConfig(num_layers=cfg.num_layers,
+                           kv_heads=cfg.kv_heads,
+                           head_dim=cfg.dims_per_head, page_size=16,
+                           num_pages=num_pages, dtype=jnp.float32)
+    model = RaggedInferenceModel(cfg, params, kv_config=kv_cfg)
+    econf = RaggedInferenceEngineConfig(
+        state_manager=StateManagerConfig(
+            max_tracked_sequences=max_seqs,
+            max_ragged_sequence_count=max_seqs,
+            max_ragged_batch_size=max_batch))
+    if serving is not None:
+        econf.serving = serving
+    return InferenceEngineV2(model, econf)
+
+
+def _pool(keyed=True, prefill_pages=96, decode_pages=96, max_seqs=8,
+          on_token=None, handoff_every=4):
+    pf = lambda: FastGenScheduler(_engine(  # noqa: E731
+        ServingOptimizationConfig(role="prefill", keyed_sampling=keyed),
+        num_pages=prefill_pages, max_seqs=max_seqs))
+    df = lambda: FastGenScheduler(_engine(  # noqa: E731
+        ServingOptimizationConfig(role="decode", keyed_sampling=keyed),
+        num_pages=decode_pages, max_seqs=max_seqs))
+    return DisaggPool(pf, df, on_token=on_token,
+                      handoff_every=handoff_every)
+
+
+def _workload(seed=1):
+    """Mixed shared-prefix workload: greedy + stochastic + stop-token
+    requests, three of four sharing a two-page prefix."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, 128, 32)
+    prompts = [np.concatenate([shared, rng.integers(0, 128, 9)]),
+               np.concatenate([shared, rng.integers(0, 128, 21)]),
+               rng.integers(0, 128, 18),
+               np.concatenate([shared, rng.integers(0, 128, 5)])]
+    params = [SamplingParams(temperature=0.0, max_new_tokens=10),
+              SamplingParams(temperature=0.9, top_k=30,
+                             max_new_tokens=8),
+              SamplingParams(temperature=0.0, max_new_tokens=12,
+                             stop_token=5),
+              SamplingParams(temperature=0.7, top_p=0.9,
+                             max_new_tokens=6)]
+    return prompts, params
+
+
+def _fused_reference(prompts, params, keyed=True, staggered=0):
+    """Token streams from the fused single-engine baseline."""
+    serving = ServingOptimizationConfig(keyed_sampling=keyed)
+    sched = FastGenScheduler(_engine(serving))
+    got = {}
+    cb = lambda u, t: got.setdefault(u, []).append(t)  # noqa: E731
+    for i, p in enumerate(prompts):
+        sched.submit(i, p, params[i])
+        for _ in range(staggered):
+            sched.step(on_token=cb)
+    while sched.has_work:
+        sched.step(on_token=cb)
+    return got
+
+
+# ---------------------------------------------------------------------------
+# satellite: role admission — a misrouted request can never sit forever
+# ---------------------------------------------------------------------------
+
+class TestRoles:
+    def test_unknown_role_raises(self):
+        eng = _engine()
+        with pytest.raises(ValueError, match="role"):
+            FastGenScheduler(eng, role="verifier")
+
+    def test_decode_role_rejects_every_submit(self):
+        sched = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="decode")))
+        before = tm.DISAGG_MISROUTED.value
+        verdict = sched.submit(1, [1, 2, 3], SamplingParams())
+        assert verdict is not None and verdict.code == "misrouted"
+        assert sched.errors[1].code == "misrouted"
+        assert not sched.has_work          # nothing enqueued
+        assert tm.DISAGG_MISROUTED.value == before + 1
+
+    def test_prefill_role_without_sink_rejects_multi_token(self):
+        sched = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="prefill")))
+        verdict = sched.submit(1, [1, 2, 3],
+                               SamplingParams(max_new_tokens=4))
+        assert verdict is not None and verdict.code == "misrouted"
+        # a single-token request completes entirely on the prefill
+        # pool (prefill + first token == the whole request)
+        assert sched.submit(2, [1, 2, 3],
+                            SamplingParams(max_new_tokens=1)) is None
+        out = sched.run_to_completion()
+        assert len(out[2]) == 1
+
+    def test_prefill_role_parks_handoff_ready(self):
+        sched = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="prefill")))
+        sched.enable_handoff_sink()
+        assert sched.submit(7, list(range(20)),
+                            SamplingParams(max_new_tokens=6)) is None
+        for _ in range(8):
+            if sched.handoff_backlog:
+                break
+            sched.step()
+        assert sched.handoff_ready_uids() == [7]
+        assert not sched.has_work          # parked, not schedulable
+        # the engine sequence stays alive until complete_handoff
+        assert sched._engine.state_manager.get_sequence(7) is not None
+        req = sched._handoff_ready[7]
+        assert len(req.generated) == 1     # exactly the first token
+
+    def test_handoff_ready_ttl_expires_structurally(self):
+        sched = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="prefill")))
+        sched.enable_handoff_sink()
+        sched.submit(3, list(range(20)),
+                     SamplingParams(max_new_tokens=6), ttl_s=0.05)
+        for _ in range(8):
+            if sched.handoff_backlog:
+                break
+            sched.step()
+        assert sched.handoff_backlog == 1
+        time.sleep(0.06)
+        sched.step()                       # expiry sweep runs
+        assert sched.errors[3].code == "expired"
+        assert sched.handoff_backlog == 0
+        assert sched._engine.state_manager.get_sequence(3) is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: lattice kinds filter + shrink guard
+# ---------------------------------------------------------------------------
+
+class TestLatticeKinds:
+    _GEO = dict(max_prompt=64, max_new_tokens=64, max_concurrency=8,
+                page_size=16, max_ragged_batch_size=256,
+                has_fresh=True, sampling=True, spec_max_draft=3)
+
+    def test_kinds_partition_the_full_lattice(self):
+        full = lattice_keys(**self._GEO)
+        parts = [lattice_keys(kinds=(k,), **self._GEO)
+                 for k in LATTICE_KINDS]
+        assert sum(len(p) for p in parts) == len(full)
+        assert set().union(*map(set, parts)) == set(full)
+        for kind, part in zip(LATTICE_KINDS, parts):
+            assert all(lattice_kind_of(k) == kind for k in part)
+
+    def test_role_filters_shrink_and_specialize(self):
+        full = lattice_keys(**self._GEO)
+        pre = lattice_keys(kinds=("prefill", "decode"), **self._GEO)
+        dec = lattice_keys(kinds=("decode", "chain", "spec"),
+                           **self._GEO)
+        assert len(pre) < len(full) and len(dec) < len(full)
+        # the decode pool carries NO prefill-geometry programs
+        assert all(k[1] == 1 or (len(k) > 4 and k[4] == "spec")
+                   for k in dec)
+        # the prefill pool carries NO chain/spec programs
+        assert all(len(k) <= 4 or k[4] not in ("chain", "spec")
+                   for k in pre)
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown lattice kinds"):
+            lattice_keys(kinds=("decode", "verify"), **self._GEO)
+
+    def test_precompile_kinds_shrink_guard(self):
+        eng = _engine(max_seqs=2, max_batch=64)
+        # sampling=False enumerates no chain/spec keys at all, so
+        # ("prefill", "decode") re-enumerates the FULL lattice — the
+        # guard must refuse rather than silently compile both pools'
+        # programs
+        with pytest.raises(ValueError, match="did not shrink"):
+            eng.precompile(max_prompt=4, max_new_tokens=16,
+                           max_concurrency=2, sampling=False,
+                           kinds=("prefill", "decode"))
+
+    def test_precompile_kinds_compiles_the_shrunk_set(self):
+        eng = _engine(max_seqs=2, max_batch=64)
+        keys = eng.precompile(max_prompt=4, max_new_tokens=16,
+                              max_concurrency=2, sampling=True,
+                              kinds=("decode", "chain"))
+        assert keys and all(k[1] == 1 for k in keys)
+        assert all(k in eng.model._step_cache for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: selective export/import (the handoff seam)
+# ---------------------------------------------------------------------------
+
+class TestSelectiveExportImport:
+    def _prefill_with(self, uids_prompts, serving=None):
+        sched = FastGenScheduler(_engine(
+            serving or ServingOptimizationConfig(role="prefill")))
+        sched.enable_handoff_sink()
+        for uid, prompt in uids_prompts:
+            sched.submit(uid, prompt, SamplingParams(max_new_tokens=6))
+        for _ in range(16):
+            if sched.handoff_backlog == len(uids_prompts):
+                break
+            sched.step()
+        return sched
+
+    def test_export_untracked_uid_raises(self):
+        sched = self._prefill_with([(1, list(range(20)))])
+        with pytest.raises(ValueError, match="non-handoff-ready"):
+            sched.export_handoff([99])
+        with pytest.raises(SnapshotError, match="untracked"):
+            sched._engine.state_manager.export_state(seq_ids=[99])
+
+    def test_import_requires_handoff_bundle_and_fresh_uids(self):
+        sched = self._prefill_with([(1, list(range(20)))])
+        bundle = sched.export_handoff([1])
+        dec = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="decode")))
+        with pytest.raises(SnapshotError, match="export_handoff"):
+            dec.import_handoff({"meta": {"version": 1},
+                                "arrays": {}})
+        stats = dec.import_handoff(bundle)
+        assert stats["uids"] == [1]
+        # the same uid again collides on the importing scheduler
+        with pytest.raises(SnapshotError, match="already live"):
+            dec.import_handoff(bundle)
+
+    def test_sharing_and_refcounts_reconstructed(self):
+        rng = np.random.default_rng(3)
+        shared = rng.integers(0, 128, 32)
+        a = np.concatenate([shared, rng.integers(0, 128, 5)])
+        # A completes first so B's admission SHARES A's indexed pages
+        # on the prefill side (same page ids, refcount 2)
+        sched = self._prefill_with([(1, a)])
+        b = np.concatenate([shared, rng.integers(0, 128, 7)])
+        sched.submit(2, b, SamplingParams(max_new_tokens=6))
+        for _ in range(16):
+            if sched.handoff_backlog == 2:
+                break
+            sched.step()
+        sm = sched._engine.state_manager
+        sd1, sd2 = sm.get_sequence(1), sm.get_sequence(2)
+        assert sd1.pages[:2] == sd2.pages[:2]      # shared on prefill
+        bundle = sched.export_handoff([1, 2])
+        # each distinct page rides the blob once
+        assert (bundle["arrays"]["page_blob"].shape[1]
+                == len(set(sd1.pages) | set(sd2.pages)))
+        dec = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="decode")))
+        dec.import_handoff(bundle)
+        dm = dec._engine.state_manager
+        d1, d2 = dm.get_sequence(1), dm.get_sequence(2)
+        assert d1.pages[:2] == d2.pages[:2]        # shared again
+        alloc = dm.kv_cache.allocator
+        assert all(alloc.ref_count(p) == 2 for p in d1.pages[:2])
+        dm.check_invariants()
+        sched.complete_handoff([1, 2])
+        sm.check_invariants()
+        # prefill side retains the full prefix pages as parked cache
+        assert sm.kv_cache.allocator.parked_pages > 0
+
+    def test_second_handoff_dedups_against_decode_cache(self):
+        rng = np.random.default_rng(4)
+        shared = rng.integers(0, 128, 32)
+        sched = self._prefill_with(
+            [(1, np.concatenate([shared, rng.integers(0, 128, 5)]))])
+        dec = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="decode")))
+        s1 = dec.import_handoff(sched.export_handoff([1]))
+        sched.complete_handoff([1])
+        assert s1["pages_shared"] == 0 and s1["pages_streamed"] >= 3
+        # request 2 shares the prefix; its prefill reuses the PARKED
+        # pages on the prefill side, and its handoff finds the same
+        # chain digests already indexed on the decode side
+        sched.submit(2, np.concatenate([shared,
+                                        rng.integers(0, 128, 9)]),
+                     SamplingParams(max_new_tokens=6))
+        for _ in range(16):
+            if sched.handoff_backlog:
+                break
+            sched.step()
+        s2 = dec.import_handoff(sched.export_handoff([2]))
+        sched.complete_handoff([2])
+        assert s2["pages_shared"] == 2          # the two shared pages
+        dm = dec._engine.state_manager
+        alloc = dm.kv_cache.allocator
+        assert all(alloc.ref_count(p) == 2
+                   for p in dm.get_sequence(2).pages[:2])
+        dm.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: end-to-end two-pool serving, tokenwise identical to fused
+# ---------------------------------------------------------------------------
+
+class TestHandoffParity:
+    def _disagg(self, prompts, params, keyed=True, staggered=0,
+                **pool_kw):
+        pool = _pool(keyed=keyed, **pool_kw)
+        for i, p in enumerate(prompts):
+            pool.submit(i, p, params[i])
+            for _ in range(staggered):
+                pool.step()
+        res = pool.run_to_completion()
+        assert not pool.errors
+        return res, pool
+
+    def test_greedy_parity_mixed_shared_prefix(self):
+        prompts, params = _workload()
+        params = [SamplingParams(temperature=0.0,
+                                 max_new_tokens=p.max_new_tokens,
+                                 stop_token=p.stop_token)
+                  for p in params]
+        want = _fused_reference(prompts, params, keyed=False)
+        got, _ = self._disagg(prompts, params, keyed=False)
+        assert got == want
+
+    def test_sampled_parity_needs_keyed_sampling(self):
+        prompts, params = _workload()
+        want = _fused_reference(prompts, params, keyed=True)
+        got, _ = self._disagg(prompts, params, keyed=True)
+        assert got == want
+
+    def test_parity_with_staggered_arrivals_and_dedup(self):
+        prompts, params = _workload(seed=7)
+        want = _fused_reference(prompts, params, keyed=True,
+                                staggered=4)
+        before = tm.DISAGG_PAGES_SHARED.value
+        got, pool = self._disagg(prompts, params, keyed=True,
+                                 staggered=4, handoff_every=1)
+        assert got == want
+        # staggered same-prefix arrivals dedup on the decode side —
+        # prefix-cache hit rates survive the pool boundary
+        assert tm.DISAGG_PAGES_SHARED.value - before > 0
+
+    def test_first_token_produced_on_prefill_pool(self):
+        prompts, params = _workload()
+        seen_before_decode = {}
+        pool_ref = []
+
+        def spy(uid, tok):
+            pool = pool_ref[0]
+            if uid not in seen_before_decode:
+                # the FIRST token of every request is delivered while
+                # the request still lives on the prefill side — TTFT
+                # never waits on the transfer
+                seen_before_decode[uid] = (
+                    pool.request(uid).replica == "prefill")
+
+        pool = _pool(on_token=spy)
+        pool_ref.append(pool)
+        for i, p in enumerate(prompts):
+            pool.submit(i, p, params[i])
+        pool.run_to_completion()
+        assert seen_before_decode == {i: True
+                                      for i in range(len(prompts))}
+
+    def test_threaded_serve_matches_fused(self):
+        prompts, params = _workload(seed=9)
+        want = _fused_reference(prompts, params, keyed=True)
+        pool = _pool(keyed=True)
+        pool.start()
+        try:
+            for i, p in enumerate(prompts):
+                pool.submit(i, p, params[i])
+            assert pool.serve_until_idle(timeout_s=60.0)
+        finally:
+            pool.stop()
+        assert pool.results() == want and not pool.errors
+
+    def test_mid_preemption_handoff(self):
+        prompts, params = _workload(seed=11)
+        want = _fused_reference(prompts, params, keyed=True)
+        pool = _pool(keyed=True, handoff_every=64)  # let backlog build
+        for i, p in enumerate(prompts):
+            pool.submit(i, p, params[i])
+        for _ in range(32):
+            if pool.prefill.handoff_backlog:
+                break
+            pool.step()
+        # KV pressure offloads a handoff-ready victim to host — the
+        # bundle must carry its blob and the decode side restore it
+        uid = pool.prefill.handoff_ready_uids()[0]
+        pool.prefill._engine.offload_sequence(uid)
+        sd = pool.prefill._engine.state_manager.get_sequence(uid)
+        assert sd.host_blob is not None
+        got = pool.run_to_completion()
+        assert got == want and not pool.errors
+        stats = pool.stats()
+        assert stats["handed_off"] == len(prompts)
+
+
+# ---------------------------------------------------------------------------
+# backpressure: a refused import defers or fails structurally
+# ---------------------------------------------------------------------------
+
+class TestBackpressure:
+    def test_oversized_sequence_fails_structurally(self):
+        # a decode pool that can never hold the sequence: the handoff
+        # is refused, nothing mutates, and the request ends with a
+        # structured "oom" verdict instead of sitting forever
+        pool = _pool(decode_pages=2)
+        before = tm.DISAGG_HANDOFF_RETRY.value
+        pool.submit(1, list(range(70)),
+                    SamplingParams(max_new_tokens=6))
+        res = pool.run_to_completion(max_stalls=64)
+        assert res == {}                     # nothing completed...
+        assert pool.idle                     # ...and nothing hangs
+        err = pool.errors.get(1)
+        assert err is not None and err.code == "oom"
+        assert len(err.tokens) == 1          # first token preserved
+        assert tm.DISAGG_HANDOFF_RETRY.value > before
+        pool.decode._engine.state_manager.check_invariants()
+
+    def test_import_refusal_mutates_nothing(self):
+        sched = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="prefill")))
+        sched.enable_handoff_sink()
+        sched.submit(1, list(range(70)),
+                     SamplingParams(max_new_tokens=6))
+        for _ in range(16):
+            if sched.handoff_backlog:
+                break
+            sched.step()
+        bundle = sched.export_handoff([1])
+        dec = FastGenScheduler(_engine(
+            ServingOptimizationConfig(role="decode"), num_pages=2))
+        dm = dec._engine.state_manager
+        with pytest.raises(KVAllocationError):
+            dec.import_handoff(bundle)
+        assert dm.n_tracked_sequences == 0
+        assert dm.kv_cache.allocator.live_pages == 0
+        dm.check_invariants()
+
+    def test_run_completes_under_decode_pressure(self):
+        # decode pool with room for roughly one sequence at a time:
+        # handoffs defer while it drains, then land — nothing lost
+        prompts, params = _workload(seed=13)
+        want = _fused_reference(prompts, params, keyed=True)
+        pool = _pool(keyed=True, decode_pages=16)
+        for i, p in enumerate(prompts):
+            pool.submit(i, p, params[i])
+        got = pool.run_to_completion(max_stalls=2048)
+        assert got == want and not pool.errors
+
+
+# ---------------------------------------------------------------------------
+# keyed (schedule-invariant) sampling
+# ---------------------------------------------------------------------------
+
+class TestKeyedSampling:
+    def test_schedule_invariance(self):
+        prompts, params = _workload(seed=17)
+        a = _fused_reference(prompts, params, keyed=True, staggered=0)
+        b = _fused_reference(prompts, params, keyed=True, staggered=3)
+        assert a == b
+
+    def test_keyed_greedy_matches_unkeyed(self):
+        prompts, _ = _workload(seed=19)
+        params = [SamplingParams(temperature=0.0, max_new_tokens=6)
+                  for _ in prompts]
+        assert (_fused_reference(prompts, params, keyed=True)
+                == _fused_reference(prompts, params, keyed=False))
+
+    def test_keyed_split_path_matches_fused_path(self):
+        # the escape-hatch host sampler derives the same per-(uid,
+        # position) keys as the fused on-device derivation
+        prompts, params = _workload(seed=23)
+        fused = _fused_reference(prompts, params, keyed=True)
+        sched = FastGenScheduler(
+            _engine(ServingOptimizationConfig(keyed_sampling=True)),
+            serving=ServingOptimizationConfig(
+                fused_step=False, on_device_sampling=False,
+                async_scheduling=False, keyed_sampling=True))
+        got = {}
+        for i, p in enumerate(prompts):
+            sched.submit(i, p, params[i])
+        while sched.has_work:
+            sched.step(on_token=lambda u, t:
+                       got.setdefault(u, []).append(t))
+        assert got == fused
+
+    def test_keyed_rng_base_never_splits(self):
+        sched = FastGenScheduler(
+            _engine(ServingOptimizationConfig(keyed_sampling=True)))
+        base = np.asarray(jax.random.key_data(sched._rng)).copy()
+        prompts, params = _workload(seed=29)
+        for i, p in enumerate(prompts):
+            sched.submit(i, p, params[i])
+        sched.run_to_completion()
+        assert np.array_equal(
+            np.asarray(jax.random.key_data(sched._rng)), base)
+
+
+# ---------------------------------------------------------------------------
+# snapshot integration: handoff-ready requests survive a snapshot
+# ---------------------------------------------------------------------------
+
+class TestSnapshotIntegration:
+    def test_snapshot_roundtrips_handoff_ready(self):
+        sched = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="prefill")))
+        sched.enable_handoff_sink()
+        sched.submit(5, list(range(20)),
+                     SamplingParams(max_new_tokens=6))
+        for _ in range(8):
+            if sched.handoff_backlog:
+                break
+            sched.step()
+        bundle = sched.snapshot()
+        fresh = FastGenScheduler(
+            _engine(ServingOptimizationConfig(role="prefill")))
+        fresh.enable_handoff_sink()
+        fresh.restore(bundle)
+        assert fresh.handoff_ready_uids() == [5]
+        assert fresh._handoff_ready[5].generated == \
+            sched._handoff_ready[5].generated
+
+
+# ---------------------------------------------------------------------------
+# tools: the two-pool replay drives the real harness
+# ---------------------------------------------------------------------------
+
+class TestReplayDisagg:
+    def test_replay_disagg_structural_parity(self):
+        import os
+        from tools.replay_trace import run_replay_disagg
+        trace = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "traces",
+            "sample_200.jsonl")
+        out = run_replay_disagg(trace, limit=8, warmup=False)
+        assert out["diff"]["structural_ok"], out["diff"]["problems"]
+        assert out["replay"]["lost"] == 0
+        assert out["replay"]["handoffs"] >= 8

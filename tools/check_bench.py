@@ -45,7 +45,9 @@ FLEET_GROW_TOL = 0.40
 _THROUGHPUT_RE = re.compile(
     r"(^value$|_tok_s$|_req_s$|_hit_rate$|goodput|_speedup_)")
 _LATENCY_RE = re.compile(r"_ms$")
-_FLEET_RE = re.compile(r"^(fastgen_fleet_|pool_)")
+#: disagg_* rides the fleet tolerances too: its handoff latency and
+#: per-pool rates are scheduling-interleave sensitive on CPU debug
+_FLEET_RE = re.compile(r"^(fastgen_fleet_|pool_|disagg_)")
 #: parsed keys that are not a measured quantity at all
 _SKIP_RE = re.compile(
     r"(^metric$|^unit$|error|^cpu_fallback$|_model$|_path$|_policy$|"
@@ -166,6 +168,53 @@ def pool_findings(cur: Dict) -> List[str]:
     return out
 
 
+def disagg_findings(cur: Dict) -> List[str]:
+    """In-round disaggregation gate (ISSUE 13): the acceptance
+    invariants of the two-pool leg — nothing lost, output tokenwise
+    identical to the fused engine, zero on-path compiles, each pool's
+    compiled-program count strictly below the fused engine's, and the
+    specialization inequalities (prefill-pool MFU and decode-pool HBM
+    rate strictly above the fused baseline's gauges)."""
+    out: List[str] = []
+    if "disagg_lost_requests" not in cur:
+        return out      # leg didn't run this round
+    lost = cur.get("disagg_lost_requests")
+    if isinstance(lost, (int, float)) and lost > 0:
+        out.append(f"disagg leg LOST {lost} request(s) — every handoff "
+                   "must end as tokens or a structured error")
+    if cur.get("disagg_tokenwise_identical") in (0, False):
+        out.append("disagg two-pool output is NOT tokenwise identical "
+                   "to the fused engine (keyed sampling / handoff "
+                   "residual state broken?)")
+    comp = cur.get("disagg_compile_on_path_total")
+    if isinstance(comp, (int, float)) and comp > 0:
+        out.append(f"disagg measured run compiled {comp} program(s) "
+                   "on-path (warmup no longer covers the two-pool key "
+                   "sequence)")
+    for pool in ("prefill", "decode"):
+        progs = cur.get(f"disagg_programs_{pool}")
+        fused = cur.get("disagg_programs_fused")
+        if (isinstance(progs, (int, float))
+                and isinstance(fused, (int, float)) and progs >= fused):
+            out.append(f"disagg {pool} pool compiled {progs} programs, "
+                       f"not below the fused engine's {fused} — the "
+                       "role lattice shrink regressed")
+    mfu, fmfu = cur.get("disagg_prefill_mfu"), cur.get("disagg_fused_mfu")
+    if (isinstance(mfu, (int, float)) and isinstance(fmfu, (int, float))
+            and fmfu > 0 and mfu <= fmfu):
+        out.append(f"prefill-pool MFU ({mfu:.3g}) is not above the "
+                   f"fused baseline's ({fmfu:.3g}) on the replayed "
+                   "trace")
+    hbm, fhbm = (cur.get("disagg_decode_hbm_gb_s"),
+                 cur.get("disagg_fused_hbm_gb_s"))
+    if (isinstance(hbm, (int, float)) and isinstance(fhbm, (int, float))
+            and fhbm > 0 and hbm <= fhbm):
+        out.append(f"decode-pool HBM GB/s ({hbm:.3g}) is not above the "
+                   f"fused baseline's ({fhbm:.3g}) on the replayed "
+                   "trace")
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--dir", default=REPO_ROOT,
@@ -201,6 +250,7 @@ def main(argv=None) -> int:
     findings = compare(prev, cur)
     findings += [("note", m) for m in spec_findings(cur)]
     findings += [("note", m) for m in pool_findings(cur)]
+    findings += [("note", m) for m in disagg_findings(cur)]
     regressions = [m for sev, m in findings if sev == "regression"]
     notes = [m for sev, m in findings if sev == "note"]
     label = (f"{os.path.basename(prev_path)} -> "

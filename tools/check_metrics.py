@@ -30,7 +30,7 @@ from typing import Dict, List
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 AREAS = ("serving", "comm", "kv", "train", "fastgen", "chaos",
-         "fleet", "slo", "telemetry", "pool")
+         "fleet", "slo", "telemetry", "pool", "disagg")
 NAME_RE = re.compile(
     r"^ds_(%s)_[a-z][a-z0-9_]*$" % "|".join(AREAS))
 

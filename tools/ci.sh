@@ -25,6 +25,11 @@
 #                      checked-in trace; one replica is drain-migrated
 #                      away mid-replay; asserts exact gen-length parity
 #                      and ZERO lost requests
+#   5b. disagg smoke — tools/replay_trace.py --disagg --check
+#                      (ISSUE 13): the same 32 requests through the
+#                      two-pool prefill/decode scheduler with
+#                      committed-page KV streaming handoffs; asserts
+#                      structural parity AND zero lost requests
 #   6. metric lint   — tools/check_metrics.py (naming convention +
 #                      DESIGN.md documentation + no dead metrics for
 #                      every ds_* metric)
@@ -60,6 +65,10 @@ python tools/fleetctl.py --smoke
 
 echo "== replica-pool router smoke (migrate mid-replay) =="
 python tools/fleetctl.py --pool-smoke
+
+echo "== disaggregated two-pool smoke (KV-streaming handoffs) =="
+python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
+    --limit 32 --disagg --check > /dev/null
 
 echo "== metric namespace lint =="
 python tools/check_metrics.py

@@ -153,22 +153,16 @@ def share_signature_prompts(prompts: List[np.ndarray], page_size: int
 
 
 # -- engine construction -----------------------------------------------------
-def build_replay_engine(meta: Dict[str, Any],
+def _replay_model_parts(meta: Dict[str, Any],
                         requests: List[Dict[str, Any]],
-                        model_size: str = "debug",
-                        num_pages: int = 0,
-                        max_seqs: int = 32):
-    """A small engine whose geometry (page size, context, KV pool) fits
-    the trace.  The replay measures SCHEDULING/shape behavior — lattice
-    coverage, share structure, relative SLOs — so the weights are
-    random-init and the model family is the debug config unless a
-    larger one is requested."""
+                        model_size: str = "debug"):
+    """(cfg, params, page, need): the model geometry every replay
+    engine shares — factored out so the disagg mode can build TWO
+    engines over ONE weight tree (tokenwise-identical continuations
+    need identical weights across the pools)."""
     import jax
     import jax.numpy as jnp
     from flax.core import meta as flax_meta
-    from deepspeed_tpu.inference.v2 import (
-        InferenceEngineV2, KVCacheConfig, RaggedInferenceEngineConfig,
-        RaggedInferenceModel, StateManagerConfig)
     from deepspeed_tpu.models.llama import LlamaForCausalLM
 
     page = int(meta.get("page_size", 16))
@@ -179,8 +173,16 @@ def build_replay_engine(meta: Dict[str, Any],
         max_seq *= 2
     model_def = LlamaForCausalLM(model_size, max_seq_len=max(max_seq, 64),
                                  dtype=jnp.float32)
-    cfg = model_def.cfg
     params = flax_meta.unbox(model_def.init_params(jax.random.key(0)))
+    return model_def.cfg, params, page, need
+
+
+def _build_engine(cfg, params, page: int, need: int, num_pages: int,
+                  max_seqs: int, serving=None):
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.v2 import (
+        InferenceEngineV2, KVCacheConfig, RaggedInferenceEngineConfig,
+        RaggedInferenceModel, StateManagerConfig)
     if not num_pages:
         # pool sized for max_seqs concurrent worst-case sequences
         per_seq = -(-need // page)
@@ -190,18 +192,66 @@ def build_replay_engine(meta: Dict[str, Any],
                            head_dim=cfg.dims_per_head, page_size=page,
                            num_pages=num_pages, dtype=jnp.float32)
     model = RaggedInferenceModel(cfg, params, kv_config=kv_cfg)
-    return InferenceEngineV2(model, RaggedInferenceEngineConfig(
+    econf = RaggedInferenceEngineConfig(
         state_manager=StateManagerConfig(
             max_tracked_sequences=max_seqs,
             max_ragged_sequence_count=max_seqs,
-            max_ragged_batch_size=max(256, 4 * page))))
+            max_ragged_batch_size=max(256, 4 * page)))
+    if serving is not None:
+        econf.serving = serving
+    return InferenceEngineV2(model, econf)
+
+
+def build_replay_engine(meta: Dict[str, Any],
+                        requests: List[Dict[str, Any]],
+                        model_size: str = "debug",
+                        num_pages: int = 0,
+                        max_seqs: int = 32,
+                        serving=None):
+    """A small engine whose geometry (page size, context, KV pool) fits
+    the trace.  The replay measures SCHEDULING/shape behavior — lattice
+    coverage, share structure, relative SLOs — so the weights are
+    random-init and the model family is the debug config unless a
+    larger one is requested."""
+    cfg, params, page, need = _replay_model_parts(meta, requests,
+                                                  model_size)
+    return _build_engine(cfg, params, page, need, num_pages, max_seqs,
+                         serving=serving)
+
+
+def build_disagg_engines(meta: Dict[str, Any],
+                         requests: List[Dict[str, Any]],
+                         model_size: str = "debug",
+                         max_seqs: int = 32,
+                         keyed: bool = True):
+    """(prefill_engine, decode_engine) for the two-pool replay
+    (ISSUE 13): one weight tree, two engines, each with its serving
+    role; ``keyed`` turns on schedule-invariant sampling on both so
+    sampled requests replay tokenwise identical to the fused engine.
+    The decode engine runs a 2x WIDER slot geometry than the prefill
+    engine — per-row decode cost is tiny, so the decode pool batches
+    far more concurrent sequences per program than a fused engine
+    whose one geometry must also fit prompt chunks (exactly the
+    per-pool batch-shape freedom disaggregation exists to buy)."""
+    from deepspeed_tpu.inference.v2 import ServingOptimizationConfig
+    cfg, params, page, need = _replay_model_parts(meta, requests,
+                                                  model_size)
+    pre = _build_engine(
+        cfg, params, page, need, 0, max_seqs,
+        serving=ServingOptimizationConfig(role="prefill",
+                                          keyed_sampling=keyed))
+    dec = _build_engine(
+        cfg, params, page, need, 0, 2 * max_seqs,
+        serving=ServingOptimizationConfig(role="decode",
+                                          keyed_sampling=keyed))
+    return pre, dec
 
 
 # -- the replay loop ---------------------------------------------------------
 def replay(engine, requests: List[Dict[str, Any]],
            prompts: List[np.ndarray], speed: float = 0.0,
            token_budget: Optional[int] = None,
-           serving=None) -> Dict[str, Any]:
+           serving=None, on_token=None) -> Dict[str, Any]:
     """Re-issue the trace against a fresh FastGenScheduler on
     ``engine``.  ``speed=0`` submits everything up front (as fast as
     the scheduler drains); ``speed>0`` paces submissions at the
@@ -221,11 +271,12 @@ def replay(engine, requests: List[Dict[str, Any]],
     with get_workload_trace().suspended():
         return _replay_impl(FastGenScheduler, SamplingParams, tm,
                             engine, requests, prompts, speed,
-                            token_budget, serving)
+                            token_budget, serving, on_token)
 
 
 def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
-                 prompts, speed, token_budget, serving) -> Dict[str, Any]:
+                 prompts, speed, token_budget, serving,
+                 user_on_token=None) -> Dict[str, Any]:
     order = sorted(range(len(requests)),
                    key=lambda i: float(requests[i].get("arrival_s", 0.0)))
     params = [SamplingParams(
@@ -243,16 +294,19 @@ def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
     gen: Dict[int, int] = {}
     submitted: List[int] = []
     token_count = [0]
+    busy_s = 0.0
     nxt = 0
     stalls = 0
 
-    def on_token(uid: int, _tok: int) -> None:
+    def on_token(uid: int, tok: int) -> None:
         # per-token accounting MUST ride the callback: a speculative
         # step commits a whole accepted block per row per step, so the
         # step() return dict (one entry per uid) undercounts
         token_count[0] += 1
         gen[uid] = gen.get(uid, 0) + 1
         first_t.setdefault(uid, time.perf_counter())
+        if user_on_token is not None:
+            user_on_token(uid, tok)
 
     t0 = time.perf_counter()
     while nxt < len(order) or sched.has_work:
@@ -269,7 +323,9 @@ def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
                 submitted.append(i)
             nxt += 1
         if sched.has_work:
+            t_step = time.perf_counter()
             out = sched.step(on_token=on_token)
+            busy_s += time.perf_counter() - t_step
             stalls = (stalls + 1 if sched.last_step_scheduled == 0
                       and not out else 0)
             if stalls > 64:
@@ -292,6 +348,7 @@ def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
         "gen_lens": {i: gen.get(i, 0) for i in submitted},
         "errors": {int(u): e.code for u, e in sched.errors.items()},
         "wall_s": round(total, 4),
+        "busy_s": round(busy_s, 4),
         "decode_tok_s": (round(token_count[0] / total, 1) if total
                          else None),
         "ttft_p50_ms": percentile(ttfts, 50),
@@ -301,6 +358,325 @@ def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
         "spec_drafted": sched._spec_drafted_cum,
         "spec_accepted": sched._spec_accepted_cum,
     }
+
+
+# -- the two-pool (disaggregated) replay loop --------------------------------
+def replay_disagg(prefill_engine, decode_engine,
+                  requests: List[Dict[str, Any]],
+                  prompts: List[np.ndarray],
+                  speed: float = 0.0,
+                  threaded: bool = False,
+                  on_token=None) -> Dict[str, Any]:
+    """Re-issue the trace through a fresh :class:`DisaggPool` over the
+    two prebuilt engines (ISSUE 13).  Same submission/pacing contract
+    and report shape as :func:`replay`, so ``diff_replay`` diffs both
+    modes; extra keys carry the handoff facts (count/bytes/latency,
+    streamed-vs-shared pages), the per-pool cost facts (prefill-pool
+    MFU captured the moment the prefill pool drains — its busy window,
+    not the whole run — and decode-pool HBM GB/s over the run), and
+    ``lost`` (requests neither completed nor structurally errored; the
+    CI smoke asserts 0).  ``threaded`` drives the pool through its
+    ``start()`` stepper threads so the two pools genuinely overlap
+    (the bench mode; keyed sampling keeps token values deterministic
+    regardless of thread interleaving)."""
+    from deepspeed_tpu.inference.v2 import (FastGenScheduler,
+                                            SamplingParams)
+    from deepspeed_tpu.serving import DisaggPool
+    from deepspeed_tpu.telemetry import metrics as tm
+    from deepspeed_tpu.telemetry.workload_trace import get_workload_trace
+
+    order = sorted(range(len(requests)),
+                   key=lambda i: float(requests[i].get("arrival_s", 0.0)))
+    params = [SamplingParams(
+        temperature=float(r.get("temperature", 0.0)),
+        top_k=int(r.get("top_k", 0)), top_p=float(r.get("top_p", 1.0)),
+        max_new_tokens=max(1, int(r["gen_len"]))) for r in requests]
+
+    submit_t: Dict[int, float] = {}
+    first_t: Dict[int, float] = {}
+    gen: Dict[int, int] = {}
+    submitted: List[int] = []
+    token_count = [0]
+
+    def _tap(uid: int, tok: int) -> None:
+        token_count[0] += 1
+        gen[uid] = gen.get(uid, 0) + 1
+        first_t.setdefault(uid, time.perf_counter())
+        if on_token is not None:
+            on_token(uid, tok)
+
+    pool = DisaggPool(
+        lambda: FastGenScheduler(prefill_engine),
+        lambda: FastGenScheduler(decode_engine),
+        on_token=_tap)
+
+    miss0 = tm.FASTGEN_STEP_CACHE_MISS.value
+    comp0 = tm.FASTGEN_COMPILE_ON_PATH.value
+    hand0 = tm.DISAGG_HANDOFFS.value
+    bytes0 = tm.DISAGG_HANDOFF_BYTES.value
+    stream0 = tm.DISAGG_PAGES_STREAMED.value
+    share0 = tm.DISAGG_PAGES_SHARED.value
+    handoff_ms: List[float] = []
+    pool._on_handoff_ms = handoff_ms.append
+
+    nxt = 0
+    stalls = 0
+    with get_workload_trace().suspended():
+        t0 = time.perf_counter()
+        if threaded:
+            pool.start()
+        try:
+            while nxt < len(order) or not pool.idle:
+                now = time.perf_counter()
+                elapsed = (now - t0) * (speed if speed > 0 else 1.0)
+                while nxt < len(order) and (
+                        speed <= 0
+                        or float(requests[order[nxt]]
+                                 .get("arrival_s", 0.0)) <= elapsed):
+                    i = order[nxt]
+                    verdict = pool.submit(i, prompts[i], params[i])
+                    if verdict is None:
+                        submit_t[i] = time.perf_counter()
+                        submitted.append(i)
+                    nxt += 1
+                if threaded:
+                    if pool.idle and nxt >= len(order):
+                        break
+                    time.sleep(0.002)
+                    continue
+                if not pool.idle:
+                    before = token_count[0]
+                    pool.step()
+                    stalls = (stalls + 1 if token_count[0] == before
+                              else 0)
+                    if stalls > 512:
+                        raise RuntimeError(
+                            "disagg replay stalled: requests "
+                            "unschedulable (trace needs a larger KV "
+                            "pool than the replay engines have)")
+                elif nxt < len(order) and speed > 0:
+                    gap = (float(requests[order[nxt]]
+                                 .get("arrival_s", 0.0)) - elapsed) / speed
+                    time.sleep(min(max(gap, 0.0), 0.01))
+            total = time.perf_counter() - t0
+        finally:
+            if threaded:
+                pool.stop()
+    # per-pool cost over each pool's BUSY window (seconds inside its
+    # own scheduler steps): the specialization claim is about what a
+    # role-shrunk program mix does with the hardware while it runs,
+    # independent of how the two pools share a host/thread schedule.
+    # ONE implementation (the pool's gauge refresh) feeds both the
+    # ds_disagg_* gauges and this report
+    cost = pool.refresh_cost_gauges()
+
+    ttfts = [(first_t[i] - submit_t[i]) * 1e3
+             for i in submitted if i in first_t]
+    lost = [i for i in submitted
+            if not pool.request(i).finalized]
+    return {
+        "requests_submitted": len(submitted),
+        "submit_order": submitted,
+        "gen_lens": {i: gen.get(i, 0) for i in submitted},
+        "errors": {int(u): e.code for u, e in pool.errors.items()},
+        "lost": len(lost),
+        "wall_s": round(total, 4),
+        "decode_tok_s": (round(token_count[0] / total, 1) if total
+                         else None),
+        "ttft_p50_ms": percentile(ttfts, 50),
+        "ttft_p99_ms": percentile(ttfts, 99),
+        "step_cache_miss": tm.FASTGEN_STEP_CACHE_MISS.value - miss0,
+        "compile_on_path": tm.FASTGEN_COMPILE_ON_PATH.value - comp0,
+        "spec_drafted": 0,
+        "spec_accepted": 0,
+        "handoffs": tm.DISAGG_HANDOFFS.value - hand0,
+        "handoff_bytes": tm.DISAGG_HANDOFF_BYTES.value - bytes0,
+        "handoff_p50_ms": percentile(handoff_ms, 50),
+        "pages_streamed": tm.DISAGG_PAGES_STREAMED.value - stream0,
+        "pages_shared": tm.DISAGG_PAGES_SHARED.value - share0,
+        "prefill_mfu": float(cost["prefill_mfu"]),
+        "prefill_busy_s": round(pool.prefill_busy_s, 4),
+        "decode_hbm_gb_s": float(cost["decode_hbm_gb_s"]),
+        "decode_busy_s": round(pool.decode_busy_s, 4),
+        "programs_prefill": len(prefill_engine.model._step_cache),
+        "programs_decode": len(decode_engine.model._step_cache),
+    }
+
+
+def run_replay_disagg(trace_path: str, limit: int = 0,
+                      include_errors: bool = False, speed: float = 0.0,
+                      model_size: str = "debug", seed: int = 0,
+                      warmup: bool = True, tolerance: float = 4.0,
+                      keyed: bool = True) -> Dict[str, Any]:
+    """load → synthesize → (shape-warmup) → measured two-pool replay →
+    structural diff: the disagg counterpart of :func:`run_replay`,
+    behind the CI disagg smoke and bench.py's BENCH_DISAGG leg."""
+    trace = load_trace(trace_path)
+    requests = trace["requests"]
+    if not include_errors:
+        requests = [r for r in requests if r.get("outcome") == "ok"]
+    if limit:
+        requests = requests[:limit]
+    if not requests:
+        raise ValueError(f"{trace_path}: no replayable requests")
+    meta = trace["meta"]
+    page = int(meta.get("page_size", 16))
+    pre_eng, dec_eng = build_disagg_engines(meta, requests,
+                                            model_size=model_size,
+                                            keyed=keyed)
+    vocab = min(int(meta.get("vocab_size", 0))
+                or pre_eng.model.cfg.vocab_size,
+                pre_eng.model.cfg.vocab_size)
+    prompts = synthesize_prompts(requests, page, vocab, seed=seed)
+    if warmup:
+        replay_disagg(pre_eng, dec_eng, requests, prompts, speed=0.0)
+        _reset_engine(pre_eng)
+        _reset_engine(dec_eng)
+    report = replay_disagg(pre_eng, dec_eng, requests, prompts,
+                           speed=speed)
+    verdict = diff_replay(requests, prompts, page, report,
+                          tolerance=tolerance)
+    return {"trace": trace_path, "meta": meta,
+            "requests": len(requests),
+            "replay": report, "diff": verdict}
+
+
+def run_disagg_bench(trace_path: Optional[str] = None,
+                     limit: Optional[int] = None) -> Dict[str, Any]:
+    """The BENCH_DISAGG leg (ISSUE 13): the same replayed mixed trace
+    through (a) the fused single-pool scheduler and (b) the two-pool
+    disaggregated scheduler, both with keyed sampling so the
+    output-identity claim covers the trace's SAMPLED requests too.
+    Both passes run SINGLE-threaded: the step/handoff sequence is then
+    deterministic (warmup covers exactly the measured keys — 0
+    on-path compiles by construction) and the per-pool MFU/HBM
+    numbers come from busy-window accounting, so they measure program-
+    mix specialization, not thread overlap (the threaded serve path is
+    covered by tests/test_disagg.py).  Emits the acceptance numbers:
+    prefill-pool MFU and decode-pool HBM GB/s vs the fused baseline's
+    corresponding gauges, per-pool compiled/enumerated program counts
+    vs the fused lattice's, handoff p50 ms, aggregate tok/s ratio,
+    on-path compiles, lost requests, and tokenwise identity."""
+    from deepspeed_tpu.inference.v2 import ServingOptimizationConfig
+    from deepspeed_tpu.inference.v2.engine import lattice_keys
+    from deepspeed_tpu.telemetry import metrics as tm
+
+    if trace_path is None:
+        trace_path = os.environ.get(
+            "BENCH_DISAGG_TRACE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "traces", "sample_200.jsonl"))
+    if limit is None:
+        limit = int(os.environ.get("BENCH_DISAGG_LIMIT", "64"))
+    trace = load_trace(trace_path)
+    requests = [r for r in trace["requests"]
+                if r.get("outcome") == "ok"]
+    if limit:
+        requests = requests[:limit]
+    # decode-weighted variant of the trace: disaggregation is built
+    # for workloads with a real steady-state decode phase, and the
+    # captured sample's gen lengths (~4 tokens) end before the decode
+    # pool's chain warms up — scale them (prompts/sharing/arrivals
+    # untouched; both arms serve the SAME scaled workload)
+    gen_scale = int(os.environ.get("BENCH_DISAGG_GEN_SCALE", "4"))
+    if gen_scale > 1:
+        requests = [dict(r, gen_len=int(r["gen_len"]) * gen_scale)
+                    for r in requests]
+    meta = trace["meta"]
+    page = int(meta.get("page_size", 16))
+
+    # -- fused single-pool baseline (keyed, like the disagg pools) ----
+    fused_eng = build_replay_engine(
+        meta, requests,
+        serving=ServingOptimizationConfig(keyed_sampling=True))
+    vocab = min(int(meta.get("vocab_size", 0))
+                or fused_eng.model.cfg.vocab_size,
+                fused_eng.model.cfg.vocab_size)
+    prompts = synthesize_prompts(requests, page, vocab)
+    replay(fused_eng, requests, prompts)            # shape warmup
+    _reset_engine(fused_eng)
+    fused_eng.model.reset_cost_window()
+    comp0 = tm.FASTGEN_COMPILE_ON_PATH.value
+    fused_tokens: Dict[int, List[int]] = {}
+    fused_rep = replay(
+        fused_eng, requests, prompts,
+        on_token=lambda u, t: fused_tokens.setdefault(u, []).append(t))
+    # SAME busy-window accounting as the disagg pools (seconds inside
+    # scheduler steps), so the specialization inequalities compare
+    # like with like
+    from deepspeed_tpu.inference.v2.model import serving_peak_flops
+    fused_cost = fused_eng.model.cost_summary()
+    fused_busy = max(float(fused_rep.get("busy_s") or 0.0), 1e-9)
+    fused_mfu = (float(fused_cost.get("flops_dispatched", 0.0))
+                 / fused_busy / serving_peak_flops())
+    fused_hbm = (float(fused_cost.get("bytes_dispatched", 0.0))
+                 / fused_busy / 1e9)
+    fused_compiles = tm.FASTGEN_COMPILE_ON_PATH.value - comp0
+
+    # -- two-pool disaggregated run -----------------------------------
+    pre_eng, dec_eng = build_disagg_engines(meta, requests)
+    replay_disagg(pre_eng, dec_eng, requests, prompts)  # shape warmup
+    _reset_engine(pre_eng)
+    _reset_engine(dec_eng)
+    pre_eng.model.reset_cost_window()
+    dec_eng.model.reset_cost_window()
+    # measured pass single-threaded: the step/handoff sequence is then
+    # DETERMINISTIC, so the warmup compiled exactly the keys the
+    # measured run forms (0 on-path compiles by construction, the
+    # acceptance bar) and the busy-window MFU/HBM numbers are stable
+    disagg_tokens: Dict[int, List[int]] = {}
+    rep = replay_disagg(
+        pre_eng, dec_eng, requests, prompts,
+        on_token=lambda u, t: disagg_tokens.setdefault(u, []).append(t))
+
+    identical = all(fused_tokens.get(i) == disagg_tokens.get(i)
+                    for i in range(len(requests)))
+    # enumerated (not just exercised) lattice sizes, each with ITS
+    # engine's geometry (the decode pool's wider slot range included):
+    # the compile-time claim each pool's kinds= filter buys
+    def lat(engine):
+        sm = engine._config.state_manager
+        return dict(
+            max_prompt=max(int(r["prompt_len"]) for r in requests),
+            max_new_tokens=max(int(r["gen_len"]) for r in requests),
+            max_concurrency=sm.max_ragged_sequence_count,
+            page_size=page,
+            max_ragged_batch_size=sm.max_ragged_batch_size,
+            has_fresh=getattr(engine.model, "_fresh_attention",
+                              None) is not None,
+            sampling=True, spec_max_draft=0)
+    out = {
+        "disagg_requests": len(requests),
+        "disagg_agg_tok_s": rep["decode_tok_s"],
+        "disagg_fused_tok_s": fused_rep["decode_tok_s"],
+        "disagg_speedup_vs_fused": (
+            round(rep["decode_tok_s"] / fused_rep["decode_tok_s"], 3)
+            if fused_rep["decode_tok_s"] else None),
+        "disagg_prefill_mfu": round(rep["prefill_mfu"], 9),
+        "disagg_fused_mfu": round(fused_mfu, 9),
+        "disagg_decode_hbm_gb_s": round(rep["decode_hbm_gb_s"], 4),
+        "disagg_fused_hbm_gb_s": round(fused_hbm, 4),
+        "disagg_handoff_p50_ms": rep["handoff_p50_ms"],
+        "disagg_handoffs": rep["handoffs"],
+        "disagg_handoff_bytes": rep["handoff_bytes"],
+        "disagg_pages_streamed": rep["pages_streamed"],
+        "disagg_pages_shared": rep["pages_shared"],
+        "disagg_programs_prefill": rep["programs_prefill"],
+        "disagg_programs_decode": rep["programs_decode"],
+        "disagg_programs_fused": len(fused_eng.model._step_cache),
+        "disagg_lattice_prefill": len(lattice_keys(
+            kinds=("prefill", "decode"), **lat(pre_eng))),
+        "disagg_lattice_decode": len(lattice_keys(
+            kinds=("decode", "chain", "spec"), **lat(dec_eng))),
+        "disagg_lattice_fused": len(lattice_keys(**lat(fused_eng))),
+        "disagg_compile_on_path_total": rep["compile_on_path"],
+        "disagg_fused_compile_on_path_total": fused_compiles,
+        "disagg_lost_requests": rep["lost"],
+        "disagg_tokenwise_identical": int(identical),
+        "disagg_ttft_p50_ms": rep["ttft_p50_ms"],
+        "disagg_fused_ttft_p50_ms": fused_rep["ttft_p50_ms"],
+    }
+    return out
 
 
 # -- recorded-vs-replayed diff -----------------------------------------------
@@ -464,6 +840,12 @@ def main(argv=None) -> int:
                     help="replay a second pass with speculative "
                     "decoding enabled and report accept rate + tok/s "
                     "delta (ISSUE 10)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="replay through the two-pool disaggregated "
+                    "prefill/decode scheduler (ISSUE 13): committed-"
+                    "page KV streaming handoff, keyed sampling on "
+                    "both pools; --check additionally requires zero "
+                    "lost requests")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the untimed shape-warmup pass (the "
                     "measured run then eats the XLA compiles)")
@@ -475,11 +857,20 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        out = run_replay(args.trace, limit=args.limit,
-                         include_errors=args.include_errors,
-                         speed=args.speed, model_size=args.model_size,
-                         seed=args.seed, warmup=not args.no_warmup,
-                         tolerance=args.tolerance, spec=args.spec)
+        if args.disagg:
+            out = run_replay_disagg(
+                args.trace, limit=args.limit,
+                include_errors=args.include_errors,
+                speed=args.speed, model_size=args.model_size,
+                seed=args.seed, warmup=not args.no_warmup,
+                tolerance=args.tolerance)
+        else:
+            out = run_replay(args.trace, limit=args.limit,
+                             include_errors=args.include_errors,
+                             speed=args.speed,
+                             model_size=args.model_size,
+                             seed=args.seed, warmup=not args.no_warmup,
+                             tolerance=args.tolerance, spec=args.spec)
     except ValueError as e:
         print(f"replay_trace: {e}", file=sys.stderr)
         return 1
@@ -490,6 +881,10 @@ def main(argv=None) -> int:
             json.dump(out, f, indent=1, default=str)
     problems = list(verdict["problems"]) if not verdict["structural_ok"] \
         else []
+    if args.disagg and out["replay"].get("lost"):
+        problems.append(
+            f"[disagg] {out['replay']['lost']} request(s) lost "
+            "(neither completed nor structurally errored)")
     if args.spec and not out["spec"]["diff"]["structural_ok"]:
         # the spec pass must reproduce the same structure — speculation
         # may only change throughput/metrics
