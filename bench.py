@@ -742,6 +742,25 @@ def bench_fastgen(jax):
                 sys.stderr.write(f"bench: fastgen pool leg failed: "
                                  f"{e}\n")
                 result["fastgen_pool_error"] = str(e)[:300]
+        if os.environ.get("BENCH_COLDSTART", "0") != "0":
+            # cold-start leg (ISSUE 14): three-way restore-to-first-
+            # token comparison across REAL process boundaries — cold
+            # process with no compile cache (true compiles), cold
+            # process against a warm persistent cache (disk loads),
+            # and a warm in-process control — plus precompile walls,
+            # compile-cache hit/true-compile counters, and the hard
+            # recompile-proof facts (replay compile_on_path == 0, zero
+            # true compiles, tokenwise parity).  Off by default
+            # (spawns three engine subprocesses); own try.
+            try:
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                from tools.coldstart_smoke import run_coldstart_bench
+                result.update(run_coldstart_bench())
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: fastgen coldstart leg "
+                                 f"failed: {e}\n")
+                result["fastgen_coldstart_error"] = str(e)[:300]
         return result
     except Exception as e:  # noqa: BLE001 — aux leg must not kill the bench
         sys.stderr.write(f"bench: fastgen leg failed: {e}\n")

@@ -1,8 +1,12 @@
 from .config import (FaultInjectionConfig, KVCacheUserConfig,
                      RaggedInferenceEngineConfig,
                      ServingOptimizationConfig, StateManagerConfig)
+from .compile_cache import (compile_config_digest, disable_compile_cache,
+                            enable_compile_cache)
 from .engine import InferenceEngineV2, SchedulingError, SchedulingResult
 from .factory import build_hf_engine
+from .lattice import (BucketLattice, LatticeError, fit_buckets,
+                      mine_lattice, resolve_lattice)
 from .model import RaggedInferenceModel
 from .model_implementations import (implementation_for,
                                     supported_model_types)
@@ -30,4 +34,8 @@ __all__ = [
     "SNAPSHOT_VERSION", "SnapshotError", "install_drain_handler",
     "maybe_install_drain_handler", "read_bundle", "write_bundle",
     "NgramDrafter",
+    "BucketLattice", "LatticeError", "fit_buckets", "mine_lattice",
+    "resolve_lattice",
+    "compile_config_digest", "disable_compile_cache",
+    "enable_compile_cache",
 ]

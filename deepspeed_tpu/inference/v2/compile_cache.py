@@ -1,0 +1,190 @@
+"""Persistent XLA compile cache for serving (ISSUE 14 tentpole 1).
+
+Every compiled step-cache executable is process-local: a restored
+replica, a pool ``scale_up`` spawn, or a disagg pool birth re-pays the
+full lattice compile — the cold start the PR 8 runbook flags.  This
+module wires JAX's persistent compilation cache
+(``jax_compilation_cache_dir``) behind
+``serving_optimization.compile_cache_dir`` / ``DS_COMPILE_CACHE`` so a
+second process compiling the same step keys LOADS executables from disk
+instead of compiling them.
+
+The cache directory is namespaced by a **config digest** over the model
+config, KV geometry, keyed-sampling mode, the active lattice digest,
+and the jax/jaxlib versions — a config change lands in a fresh
+subdirectory and reads as a cache miss, never a wrong executable (JAX's
+own cache key already guarantees executable correctness; the digest
+keeps unrelated configs from churning each other's entries and makes
+"which cache is this" a directory-listing fact).
+
+Loads vs true compiles are reported in
+``ds_fastgen_compile_cache_{hit,miss}_total``, fed from JAX's own
+monitoring events — every ``lower().compile()`` the engine runs
+(``precompile()`` and the ``model._get_step`` on-path fallback alike)
+is counted without touching the compile path.
+
+Degradation: an uncreatable/unwritable cache dir logs a warning and
+serving proceeds with plain compiles; corrupt cache entries are
+re-compiled (``jax_raise_persistent_cache_errors`` stays False).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Optional
+
+from ...utils.logging import logger
+
+_listener_installed = False
+#: the active cache path (None = disabled) — bench/test introspection
+_active_dir: Optional[str] = None
+
+
+def _install_listener() -> None:
+    """Count JAX's persistent-cache monitoring events into the
+    ds_fastgen_compile_cache_* counters (once per process).  The
+    events fire inside jax's compiler for every cache-eligible
+    compile, so precompile() and on-path compiles are both covered
+    with zero instrumentation on the compile path itself."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        from jax._src import monitoring
+    except ImportError:     # pragma: no cover — jax internals moved
+        logger.warning("compile cache: jax monitoring unavailable — "
+                       "ds_fastgen_compile_cache_* counters stay 0")
+        return
+    from ...telemetry import metrics as tm
+
+    def _on_event(event: str, **kwargs) -> None:
+        if event == "/jax/compilation_cache/cache_hits":
+            tm.FASTGEN_COMPILE_CACHE_HIT.inc()
+        elif event == "/jax/compilation_cache/cache_misses":
+            tm.FASTGEN_COMPILE_CACHE_MISS.inc()
+
+    monitoring.register_event_listener(_on_event)
+    _listener_installed = True
+
+
+def compile_config_digest(model_cfg: Any, kv_config: Any,
+                          keyed_sampling: bool = False,
+                          lattice_digest: str = "") -> str:
+    """The (lattice + model-config + jaxlib) digest that namespaces one
+    engine configuration's cache entries.  ``repr`` of the config
+    dataclasses is stable across processes (no ids/addresses) and
+    covers every compiled-program-shaping fact."""
+    import jax
+    import jaxlib
+    facts = {
+        "model": repr(model_cfg),
+        "kv": [int(kv_config.num_layers), int(kv_config.kv_heads),
+               int(kv_config.head_dim), int(kv_config.page_size),
+               str(kv_config.dtype)],
+        "keyed_sampling": bool(keyed_sampling),
+        "lattice": str(lattice_digest),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+    }
+    return hashlib.blake2b(
+        json.dumps(facts, sort_keys=True).encode("utf-8"),
+        digest_size=10).hexdigest()
+
+
+def enable_compile_cache(cache_dir: str, digest: str) -> Optional[str]:
+    """Point JAX's persistent compilation cache at
+    ``<cache_dir>/<digest>`` (created if missing) and install the
+    hit/miss counter listener.  Returns the active path, or None with a
+    warning when the directory can't be created or written — serving
+    degrades to plain in-process compiles, never fails."""
+    global _active_dir
+    path = os.path.join(cache_dir, digest)
+    if _active_dir is not None and _active_dir != path:
+        # the jax cache dir is PROCESS-GLOBAL: with two differently-
+        # configured engines in one process, the last one built owns
+        # the namespace and the earlier engine's later on-path
+        # compiles land under the wrong digest (still correct
+        # executables — jax's own key guarantees that — but a fresh
+        # process with the earlier config will miss them).  Loud note,
+        # last-engine-wins.
+        logger.warning(
+            "compile cache retargeted %s -> %s — the cache dir is "
+            "process-global (one engine config per process keeps "
+            "namespaces clean); the previous engine's future on-path "
+            "compiles will land in the new namespace",
+            _active_dir, path)
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, ".ds_probe")
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.unlink(probe)
+    except OSError as e:
+        logger.warning(
+            "compile cache disabled: %s is not a writable directory "
+            "(%s: %s) — serving continues with plain XLA compiles",
+            path, type(e).__name__, e)
+        return None
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_enable_compilation_cache", True)
+        # serving executables are small and fast-compiling on the debug
+        # tier; persist everything (the default 1s floor would skip the
+        # entire CPU-debug lattice)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # corrupt entries degrade to a recompile + warning, never an
+        # exception on the serving path
+        jax.config.update("jax_raise_persistent_cache_errors", False)
+    except Exception as e:   # pragma: no cover — jax option drift
+        logger.warning("compile cache disabled: jax rejected the cache "
+                       "configuration (%s: %s)", type(e).__name__, e)
+        return None
+    _reset_jax_cache()
+    _install_listener()
+    _active_dir = path
+    logger.info("persistent compile cache active at %s", path)
+    return path
+
+
+def disable_compile_cache() -> None:
+    """Detach the persistent cache (bench/test control for measuring
+    true cold compiles in-process)."""
+    global _active_dir
+    import jax
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_jax_cache()
+    _active_dir = None
+
+
+def _reset_jax_cache() -> None:
+    """Drop jax's in-process handle on the previous cache directory so
+    a re-enable under a different digest actually retargets."""
+    try:
+        from jax._src import compilation_cache as cc
+        cc.reset_cache()
+    except Exception:       # pragma: no cover — jax internals moved
+        pass
+
+
+def active_cache_dir() -> Optional[str]:
+    return _active_dir
+
+
+def counters_available() -> bool:
+    """Whether the hit/miss counters are actually being fed (the
+    monitoring listener installed).  Consumers asserting on the
+    counters (coldstart gates) must skip those checks when this is
+    False — counter degradation is survivable by design and must not
+    read as a caching failure."""
+    return _listener_installed
+
+
+def cache_dir_from_env_or_config(config_dir: str) -> str:
+    """``DS_COMPILE_CACHE`` env wins over the config field (the
+    operator repoints a fleet without touching configs)."""
+    return os.environ.get("DS_COMPILE_CACHE", "") or (config_dir or "")

@@ -109,6 +109,21 @@ class ServingOptimizationConfig:
     #: tokenwise identical to the fused engine.  Engine-build-time
     #: (changes compiled program signatures); default off
     keyed_sampling: bool = False
+    # -- recompile-proof cold starts (ISSUE 14) -------------------------
+    #: persistent XLA compile cache directory ("" = off; DS_COMPILE_CACHE
+    #: env overrides).  Entries are namespaced by a (model config + KV
+    #: geometry + lattice + jaxlib) digest, so a second process
+    #: compiling the same step keys LOADS executables from disk —
+    #: restore()/scale_up cold starts become loads, not compiles.
+    #: Unwritable/corrupt dirs degrade to plain compiles with a warning
+    compile_cache_dir: str = ""
+    #: bucket lattice: "" = the power-of-two default;
+    #: "auto:<path>" consumes a mined lattice artifact
+    #: (tools/analyze_trace.py --emit-lattice) or a raw workload-trace
+    #: ledger — non-power bucket tops fitted to observed traffic, a
+    #: smaller precompiled program set, tokenwise identical output.
+    #: A config-digest mismatch refuses at engine build (LatticeError)
+    lattice: str = ""
 
 
 @dataclasses.dataclass

@@ -54,6 +54,14 @@ class SchedulingError(RuntimeError):
 LATTICE_KINDS = ("prefill", "decode", "chain", "spec")
 
 
+def _validate_kinds(kinds: Sequence[str]) -> None:
+    unknown = set(kinds) - set(LATTICE_KINDS)
+    if unknown:
+        raise ValueError(
+            f"unknown lattice kinds {sorted(unknown)} "
+            f"(expected a subset of {LATTICE_KINDS})")
+
+
 def lattice_kind_of(key: Tuple) -> str:
     """Which :data:`LATTICE_KINDS` class one step-cache key belongs
     to — the shared classifier behind ``lattice_keys(kinds=...)``."""
@@ -62,6 +70,12 @@ def lattice_kind_of(key: Tuple) -> str:
         return "chain"
     if kind == "spec":
         return "spec"
+    if kind == "mixed":
+        # a mixed two-segment key carries a prefill segment — only a
+        # role that prefills can ever form one (mined-lattice artifacts
+        # may carry observed mixed keys; the power enumeration never
+        # emits them)
+        return "prefill"
     return "prefill" if key[1] > 1 else "decode"
 
 
@@ -83,14 +97,17 @@ def lattice_keys(max_prompt: int, max_new_tokens: int,
     (decode-geometry keys cover budget-shrunk 1-token chunks and the
     first-token sample; the chain/spec families drop), a decode pool
     takes ``("decode", "chain", "spec")`` (every Q>1 prefill bucket
-    and its fresh variants drop).  None = the full fused lattice."""
+    and its fresh variants drop).  None = the full fused lattice.
+
+    The key-family rules themselves (fresh variants, chain
+    cross-products, the spec bucket) live in
+    ``lattice.enumerate_lattice_keys`` — shared with mined
+    :class:`~..lattice.BucketLattice` artifacts (ISSUE 14), so the
+    power-of-two default and an auto lattice can't drift."""
+    from .lattice import enumerate_lattice_keys
     from .ragged.batch import MIN_PAGES, MIN_SLOTS, _bucket
     if kinds is not None:
-        unknown = set(kinds) - set(LATTICE_KINDS)
-        if unknown:
-            raise ValueError(
-                f"unknown lattice kinds {sorted(unknown)} "
-                f"(expected a subset of {LATTICE_KINDS})")
+        _validate_kinds(kinds)
 
     s_vals, q_vals, p_vals = [], [1], []
     s = _bucket(1, MIN_SLOTS)
@@ -108,53 +125,17 @@ def lattice_keys(max_prompt: int, max_new_tokens: int,
         p_vals.append(p)
         p *= 2
 
-    keys: List[Tuple] = []
-    for S in s_vals:
-        for Q in q_vals:
-            if S * Q > max_ragged_batch_size:
-                continue
-            for P in p_vals:
-                if P * page_size < Q:  # bucket can't hold its own tokens
-                    continue
-                # Q>1 buckets exist in both variants: fresh prefill
-                # (flash path) and continued prefill (paged path) — but
-                # only when the model HAS a fresh implementation (ALiBi
-                # models ignore the flag; compiling the True variant
-                # would duplicate every prefill executable)
-                for fresh in ((False, True) if Q > 1 and has_fresh
-                              else (False,)):
-                    key = (S, Q, P, fresh)
-                    keys.append(key)
-                    if not sampling:
-                        continue
-                    for greedy in (True, False):
-                        keys.append(key + ("sample", greedy))
-                        if Q == 1 and not fresh:
-                            # double-buffer chain: the previous step's
-                            # slot bucket can only be >= this one's
-                            # (chained rows are a subset of the
-                            # previous step's rows)
-                            for prev_s in s_vals:
-                                if prev_s < S:
-                                    continue
-                                keys.append((S, 1, P, False, "chain",
-                                             prev_s, greedy))
-    if sampling and spec_max_draft > 0:
-        # speculative verification buckets (ISSUE 10): decode rows
-        # dispatched as ragged Q = 1 + spec_max_draft segments.  One Q
-        # bucket covers every draft length (q_lens is dynamic); the
-        # same S*Q <= batch-size skip rule applies — a spec superbucket
-        # the scheduler can't form under strict shapes drops to the
-        # normal decode path, exactly like the mixed-step keys.
-        q_spec = _bucket(1 + spec_max_draft)
-        for S in s_vals:
-            if S * q_spec > max_ragged_batch_size:
-                continue
-            for P in p_vals:
-                if P * page_size < q_spec:
-                    continue
-                for greedy in (True, False):
-                    keys.append((S, q_spec, P, False, "spec", greedy))
+    # speculative verification buckets (ISSUE 10): decode rows
+    # dispatched as ragged Q = 1 + spec_max_draft segments.  One Q
+    # bucket covers every draft length (q_lens is dynamic); the
+    # same S*Q <= batch-size skip rule applies — a spec superbucket
+    # the scheduler can't form under strict shapes drops to the
+    # normal decode path, exactly like the mixed-step keys.
+    spec_q = _bucket(1 + spec_max_draft) if spec_max_draft > 0 else 0
+    keys = enumerate_lattice_keys(
+        s_vals, q_vals, p_vals, page_size=page_size,
+        max_ragged_batch_size=max_ragged_batch_size,
+        has_fresh=has_fresh, sampling=sampling, spec_q=spec_q)
     if kinds is not None:
         want = set(kinds)
         keys = [k for k in keys if lattice_kind_of(k) in want]
@@ -200,6 +181,58 @@ class InferenceEngineV2:
         # build, before any precompile/lattice work
         model.keyed_sampling = bool(
             getattr(self._config.serving, "keyed_sampling", False))
+        # mined bucket lattice (ISSUE 14): "auto:<artifact-or-trace>"
+        # resolves to non-power bucket tops + a precompile key set,
+        # digest-validated against THIS engine's geometry (a mismatch
+        # raises LatticeError — never a silent cold lattice).  Fixed at
+        # build: it shapes every compiled program the engine serves.
+        from .lattice import resolve_lattice
+        self._lattice = resolve_lattice(
+            getattr(self._config.serving, "lattice", "") or "",
+            page_size=kv_cfg.page_size,
+            vocab_size=int(getattr(model.cfg, "vocab_size", 0)),
+            max_ragged_batch_size=(
+                self._config.state_manager.max_ragged_batch_size))
+        prior = getattr(model, "lattice", None)
+        if getattr(model, "_lattice_bound", False) and (
+                (prior.digest if prior is not None else None)
+                != (self._lattice.digest
+                    if self._lattice is not None else None)):
+            # the lattice is a MODEL attribute (the mixed-step token
+            # pad is traced against it): two engines over one model
+            # with different lattice configs would desync the earlier
+            # engine's bucketing from the model's pad — loud note,
+            # last-engine-wins (the compile-cache retarget convention).
+            # The sentinel distinguishes a REbind from the model's
+            # first engine (power->mined rebinds must warn too)
+            from ...utils.logging import logger
+            logger.warning(
+                "engine build rebinds model.lattice (%s -> %s) — the "
+                "mixed-step pad follows the NEWEST engine's lattice; "
+                "engines sharing one model must share one lattice "
+                "config",
+                prior.digest if prior is not None else "<power>",
+                self._lattice.digest if self._lattice is not None
+                else "<power>")
+        model.lattice = self._lattice
+        model._lattice_bound = True
+        # persistent compile cache (ISSUE 14): a second process
+        # compiling the same step keys loads executables from disk —
+        # restore()/scale_up cold starts become loads, not compiles
+        from .compile_cache import (cache_dir_from_env_or_config,
+                                    compile_config_digest,
+                                    enable_compile_cache)
+        cache_dir = cache_dir_from_env_or_config(
+            getattr(self._config.serving, "compile_cache_dir", "") or "")
+        self._compile_cache_dir = None
+        if cache_dir:
+            digest = compile_config_digest(
+                model.cfg, kv_cfg,
+                keyed_sampling=model.keyed_sampling,
+                lattice_digest=(self._lattice.digest
+                                if self._lattice is not None else ""))
+            self._compile_cache_dir = enable_compile_cache(cache_dir,
+                                                           digest)
         self._state = StateManager(
             kv_cfg,
             max_tracked_sequences=self._config.state_manager.max_tracked_sequences,
@@ -294,30 +327,166 @@ class InferenceEngineV2:
             sv = self._config.serving
             spec_max_draft = (int(getattr(sv, "spec_max_draft", 0) or 0)
                               if getattr(sv, "speculative", False) else 0)
-        kwargs = dict(
-            max_prompt=max_prompt, max_new_tokens=max_new_tokens,
-            max_concurrency=(max_concurrency
-                             or sm.max_ragged_sequence_count),
-            page_size=self._model.kv_config.page_size,
-            max_ragged_batch_size=sm.max_ragged_batch_size,
-            has_fresh=getattr(self._model, "_fresh_attention",
-                              None) is not None,
-            sampling=sampling, spec_max_draft=spec_max_draft)
-        keys = lattice_keys(kinds=kinds, **kwargs)
-        if kinds is not None:
-            full = len(lattice_keys(**kwargs))
-            if len(keys) >= full:
-                raise ValueError(
-                    f"precompile(kinds={tuple(kinds)}) enumerated "
-                    f"{len(keys)} keys but the full lattice has {full} "
-                    "— the role filter did not shrink the compiled set "
-                    "(silently re-enumerating both pools' programs "
-                    "defeats disaggregation's compile-time win)")
+        if self._lattice is not None:
+            # mined auto lattice (ISSUE 14): the artifact's key set IS
+            # the precompile target — filtered to what THIS engine can
+            # actually form/serve
+            keys = self._auto_lattice_keys(sampling, spec_max_draft,
+                                           kinds, strict=strict)
+        else:
+            kwargs = dict(
+                max_prompt=max_prompt, max_new_tokens=max_new_tokens,
+                max_concurrency=(max_concurrency
+                                 or sm.max_ragged_sequence_count),
+                page_size=self._model.kv_config.page_size,
+                max_ragged_batch_size=sm.max_ragged_batch_size,
+                has_fresh=getattr(self._model, "_fresh_attention",
+                                  None) is not None,
+                sampling=sampling, spec_max_draft=spec_max_draft)
+            keys = lattice_keys(kinds=kinds, **kwargs)
+            if kinds is not None:
+                full = len(lattice_keys(**kwargs))
+                if len(keys) >= full:
+                    raise ValueError(
+                        f"precompile(kinds={tuple(kinds)}) enumerated "
+                        f"{len(keys)} keys but the full lattice has "
+                        f"{full} — the role filter did not shrink the "
+                        "compiled set (silently re-enumerating both "
+                        "pools' programs defeats disaggregation's "
+                        "compile-time win)")
         for key in keys:
             self._model.precompile_step(key, kv)
         if strict:
             self._model.strict_shapes = True
         return keys
+
+    def _auto_lattice_keys(self, sampling: bool, spec_max_draft: int,
+                           kinds: Optional[Sequence[str]],
+                           strict: bool = False) -> List[Tuple]:
+        """The mined lattice's key set, filtered to this engine:
+        sampling families only when requested, fresh variants only when
+        the model has a fresh path, spec keys only when speculation is
+        on, S*Q within this engine's batch budget, and the ISSUE 13
+        role filter (with its shrink guard).  ``strict`` drops the
+        artifact's mixed-step keys: a strict scheduler forces mixed
+        batches onto the split path unconditionally, so compiling them
+        would spend precompile wall + cache disk on programs that can
+        never dispatch."""
+        sm = self._config.state_manager
+        has_fresh = getattr(self._model, "_fresh_attention",
+                            None) is not None
+        lat = self._lattice
+        keys: List[Tuple] = []
+        for key in lat.keys:
+            kind = key[4] if len(key) > 4 else "logits"
+            if not sampling and kind != "logits":
+                continue
+            if strict and kind == "mixed":
+                continue
+            if kind == "spec":
+                if spec_max_draft <= 0:
+                    continue
+                # the spec bucket this engine will form: Q = the
+                # lattice bucket of 1 + spec_max_draft, not whatever
+                # draft depth the trace ran with
+                if key[1] != lat.bucket_q(1 + spec_max_draft):
+                    continue
+            if not has_fresh and (bool(key[3]) or (
+                    kind == "mixed" and bool(key[8]))):
+                continue    # fresh variants normalize to False anyway
+            if kind == "mixed":
+                if key[0] * 1 + key[6] * key[5] \
+                        > 2 * sm.max_ragged_batch_size:
+                    continue
+            elif key[0] * key[1] > sm.max_ragged_batch_size:
+                continue
+            keys.append(key)
+            if has_fresh and not lat.has_fresh:
+                # artifact mined on a fresh-less model (ALiBi capture)
+                # serving a fresh-capable engine: live all-new prefills
+                # WILL form the True variant — twin it so coverage
+                # holds instead of recompiling on path (mixed keys
+                # twin on the prefill segment's fresh_p at index 8)
+                if (key[1] > 1 and kind in ("logits", "sample")
+                        and not bool(key[3])):
+                    keys.append((key[0], key[1], key[2], True)
+                                + key[4:])
+                elif kind == "mixed" and not bool(key[8]):
+                    keys.append(key[:8] + (True,) + key[9:])
+        if sampling and spec_max_draft > 0:
+            # a lattice mined from a spec-free trace still serves an
+            # engine with speculation on: generate the spec family
+            # over its own tops (same inclusion rules the shared
+            # enumeration applies)
+            spec_q = lat.bucket_q(1 + spec_max_draft)
+            page = self._model.kv_config.page_size
+            have = set(keys)
+            for S in lat.s_tops:
+                if S * spec_q > sm.max_ragged_batch_size:
+                    continue
+                for P in lat.p_tops:
+                    if P * page < spec_q:
+                        continue
+                    for greedy in (True, False):
+                        key = (S, spec_q, P, False, "spec", greedy)
+                        if key not in have:
+                            keys.append(key)
+        if kinds is not None:
+            _validate_kinds(kinds)
+            want = set(kinds)
+            filtered = [k for k in keys if lattice_kind_of(k) in want]
+            if len(filtered) >= len(keys):
+                # unlike the power path (whose full lattice ALWAYS has
+                # out-of-role keys, so no shrink = a filter bug), a
+                # mined artifact can legitimately be role-pure — e.g.
+                # a lattice mined from a decode pool's own ledger has
+                # nothing but decode/chain keys.  Note it, don't abort
+                # engine startup.
+                from ...utils.logging import logger
+                logger.info(
+                    "precompile(kinds=%s): mined lattice is already "
+                    "role-pure (%d keys, nothing filtered)",
+                    tuple(kinds), len(keys))
+            keys = filtered
+        return keys
+
+    # -- compiled-key manifests (ISSUE 14: warm-born replicas) ---------------
+    def compiled_keys(self, dispatched_only: bool = True) -> List[Tuple]:
+        """The compiled-key manifest a snapshot bundle / replica
+        factory carries so a fresh engine can precompile EXACTLY the
+        programs traffic actually needs — against a warm persistent
+        compile cache each one is a disk load, not an XLA compile.
+        Default: only keys traffic DISPATCHED (a precompiled lattice
+        can be hundreds of programs; a restored replica's first steps
+        need the dozens its workload formed — the rest stay cache
+        loads on demand).  ``dispatched_only=False`` returns the whole
+        step cache."""
+        # snapshot via the GIL-atomic C-level copy: a threaded pool's
+        # stepper may be adding keys while a controller exports the
+        # manifest — sorting the live set would raise "set changed
+        # size during iteration"
+        if dispatched_only:
+            return sorted(self._model._dispatched_keys.copy(), key=repr)
+        return sorted(dict(self._model._step_cache), key=repr)
+
+    def precompile_keys(self, keys: Sequence[Sequence]) -> int:
+        """AOT-compile an explicit key manifest (JSON-round-tripped
+        lists accepted).  Unknown/uncompilable keys warn and are
+        skipped — a manifest from a slightly different build must never
+        block a restore.  Returns the number of keys now compiled."""
+        kv = self._state.kv_cache.data
+        done = 0
+        for k in keys:
+            key = tuple(k)
+            try:
+                self._model.precompile_step(key, kv)
+                done += 1
+            except Exception as e:  # noqa: BLE001 — per-key isolation
+                from ...utils.logging import logger
+                logger.warning(
+                    "precompile_keys: skipping manifest key %r "
+                    "(%s: %s)", key, type(e).__name__, e)
+        return done
 
     @staticmethod
     def _free_device_memory() -> Optional[int]:
@@ -447,7 +616,7 @@ class InferenceEngineV2:
                 descs, tokens, self._model.kv_config.page_size,
                 fresh_supported=getattr(self._model, "_fresh_attention",
                                         None) is not None,
-                min_q=min_q)
+                min_q=min_q, lattice=self._lattice)
             nbytes = (batch.q_lens.nbytes + batch.start_pos.nbytes
                       + batch.page_table.nbytes)
             if h2d_tokens:
@@ -531,11 +700,18 @@ class InferenceEngineV2:
             pages.append(max(cap, -(-(seen + len(toks)) // page)))
             if seen:
                 all_new = False
-        S = _bucket(len(batch_uids), MIN_SLOTS)
-        Q = _bucket(max(max(len(t) for t in batch_tokens), min_q))
+        if self._lattice is not None:
+            S = self._lattice.bucket_s(len(batch_uids))
+            Q = self._lattice.bucket_q(
+                max(max(len(t) for t in batch_tokens), min_q))
+            P = self._lattice.bucket_p(max(pages))
+        else:
+            S = _bucket(len(batch_uids), MIN_SLOTS)
+            Q = _bucket(max(max(len(t) for t in batch_tokens), min_q))
+            P = _bucket(max(pages), MIN_PAGES)
         fresh = (all_new and Q > 1 and not suffix[:1] == ("spec",)
                  and getattr(model, "_fresh_attention", None) is not None)
-        return (S, Q, _bucket(max(pages), MIN_PAGES), fresh) + suffix
+        return (S, Q, P, fresh) + suffix
 
     # -- fused forward+sampling steps (serving_optimization hot path) -------
     def _pad_sample_params(self, row_params, S):

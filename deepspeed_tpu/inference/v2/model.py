@@ -200,11 +200,23 @@ class RaggedInferenceModel:
         #: precompile — it changes the traced program signatures, so it
         #: is an engine-build-time fact, not a per-step toggle.
         self.keyed_sampling = False
+        #: mined bucket lattice (ISSUE 14): when set (by the engine,
+        #: from ``serving.lattice = "auto:<path>"``), batch bucketing —
+        #: including the mixed step's traced-in token-vector pad below —
+        #: uses its (possibly non-power-of-two) tops instead of the
+        #: power-of-two default.  Engine-build-time, like
+        #: ``keyed_sampling``: it shapes the compiled program set.
+        self.lattice = None
         # -- per-program cost accounting (ISSUE 9): flops/bytes from
         # compiled.cost_analysis() per step-cache key, accumulated per
         # dispatch so serving throughput gets a hardware denominator
         # (ds_fastgen_program_flops / ds_fastgen_mfu)
         self._program_costs: Dict[tuple, Dict[str, float]] = {}
+        #: every step-cache key traffic actually DISPATCHED (vs merely
+        #: precompiled) — the compiled-key manifest snapshot bundles
+        #: and replica factories carry (ISSUE 14): a restored/spawned
+        #: engine precompiles exactly these, not the whole lattice
+        self._dispatched_keys: set = set()
         self._flops_dispatched = 0.0
         self._bytes_dispatched = 0.0
         self._cost_t0: Optional[float] = None
@@ -468,6 +480,7 @@ class RaggedInferenceModel:
         workload trace's key-occupancy summary and the cost window
         behind the ds_fastgen_program_flops / _mfu gauges.  Always-on
         (ServingCounters convention): a dict lookup + float adds."""
+        self._dispatched_keys.add(key)
         wt = get_workload_trace()
         if wt.active:
             wt.note_step_key(key)
@@ -776,10 +789,13 @@ class RaggedInferenceModel:
         # pad the token vector to the slot bucket: S_d + S_p is an
         # arbitrary sum, and a later chained step keys on the EXACT
         # prev-token length — bucketing here collapses the chain-key
-        # space back to power-of-two lengths (one compile, not one per
-        # segment-sum)
+        # space back to the lattice's slot tops (one compile, not one
+        # per segment-sum); a mined lattice supplies its own tops
         from .ragged.batch import MIN_SLOTS, _bucket
-        pad = _bucket(tokens.shape[0], MIN_SLOTS) - tokens.shape[0]
+        if self.lattice is not None:
+            pad = self.lattice.bucket_s(tokens.shape[0]) - tokens.shape[0]
+        else:
+            pad = _bucket(tokens.shape[0], MIN_SLOTS) - tokens.shape[0]
         if pad:
             tokens = jnp.concatenate(
                 [tokens, jnp.zeros((pad,), jnp.int32)])

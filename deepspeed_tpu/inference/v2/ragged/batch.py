@@ -79,7 +79,8 @@ def build_batch(seqs: Sequence[SequenceDescriptor],
                 min_slots: int = MIN_SLOTS,
                 min_pages: int = MIN_PAGES,
                 fresh_supported: bool = True,
-                min_q: int = 1) -> RaggedBatch:
+                min_q: int = 1,
+                lattice=None) -> RaggedBatch:
     """Pack (descriptor, new-token) pairs into a bucketed RaggedBatch.
 
     Callers must already have reserved KV pages on each descriptor
@@ -97,12 +98,26 @@ def build_batch(seqs: Sequence[SequenceDescriptor],
     every dispatch to the ONE ``1 + spec_max_draft`` bucket so a
     short-draft step can't form a smaller off-lattice Q key (one
     compiled spec program per (S, P), not one per draft-length mix).
+
+    ``lattice`` (ISSUE 14): a mined :class:`..lattice.BucketLattice`
+    whose (possibly non-power-of-two) bucket tops replace the
+    power-of-two defaults; traffic past its largest top falls back to
+    power-of-two growth, so the lattice changes padding, never
+    correctness.  Must match what ``predict_step_key`` and
+    ``precompile`` used — the engine threads one object through all
+    three.
     """
     n = len(seqs)
     assert n == len(tokens) and n >= 1
-    S = _bucket(n, min_slots)
-    Q = _bucket(max(max(len(t) for t in tokens), min_q))
-    P = _bucket(max(max(s.allocated_capacity for s in seqs), 1), min_pages)
+    if lattice is not None:
+        S = lattice.bucket_s(n)
+        Q = lattice.bucket_q(max(max(len(t) for t in tokens), min_q))
+        P = lattice.bucket_p(max(s.allocated_capacity for s in seqs))
+    else:
+        S = _bucket(n, min_slots)
+        Q = _bucket(max(max(len(t) for t in tokens), min_q))
+        P = _bucket(max(max(s.allocated_capacity for s in seqs), 1),
+                    min_pages)
 
     token_ids = np.zeros((S, Q), dtype=np.int32)
     q_lens = np.zeros(S, dtype=np.int32)

@@ -1652,6 +1652,19 @@ class FastGenScheduler:
                 "errors": [dataclasses.asdict(e)
                            for e in self.errors.values()],
                 "engine": eng_meta,
+                # warm-born replicas (ISSUE 14): the compiled-key
+                # manifest — exactly the programs traffic formed on
+                # this engine — plus the lattice digest it was bucketed
+                # under, so restore() precompiles them up front (disk
+                # loads against a warm persistent compile cache) and a
+                # restored replica serves its first step warm
+                "compiled": {
+                    "keys": [list(k)
+                             for k in self._engine.compiled_keys()],
+                    "lattice_digest": (
+                        self._engine._lattice.digest
+                        if self._engine._lattice is not None else ""),
+                },
             }
             if path is not None:
                 write_bundle(path, meta, arrays)
@@ -1695,6 +1708,30 @@ class FastGenScheduler:
                     "queued work or is closed)")
             self._engine.state_manager.import_state(meta["engine"],
                                                     arrays)
+            # warm birth (ISSUE 14): precompile the bundle's
+            # compiled-key manifest BEFORE resuming, so the restored
+            # traffic's first steps dispatch warm — with a warm
+            # persistent compile cache these are disk loads, not
+            # compiles.  A lattice-digest mismatch (restoring onto a
+            # differently-bucketed engine) only warns: the manifest
+            # keys are then the wrong shapes to precompile usefully,
+            # but the restore itself is still correct.
+            compiled = meta.get("compiled") or {}
+            manifest = compiled.get("keys") or []
+            if manifest:
+                have = (self._engine._lattice.digest
+                        if self._engine._lattice is not None else "")
+                want = str(compiled.get("lattice_digest", "") or "")
+                if have != want:
+                    from ...utils.logging import logger
+                    logger.warning(
+                        "restore: bundle compiled-key manifest was "
+                        "recorded under lattice digest %r but this "
+                        "engine runs %r — skipping the warm-birth "
+                        "precompile (traffic will compile on first "
+                        "use)", want, have)
+                else:
+                    self._engine.precompile_keys(manifest)
             import jax.numpy as jnp
             self._rng = jax.random.wrap_key_data(
                 jnp.asarray(arrays["rng_key"], jnp.uint32))

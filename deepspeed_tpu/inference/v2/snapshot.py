@@ -20,10 +20,14 @@ Bundle layout (version 1)::
 
 The checksum covers meta AND payload, so a truncated or corrupted file
 fails :func:`read_bundle` with a structured :class:`SnapshotError` —
-never a hang, never silent partial state.  Deliberately NOT captured:
-the compiled step cache (XLA executables are process-local; a restored
-engine re-pays compile unless ROADMAP item 5's persistent compile cache
-lands) and telemetry latency stamps (process-relative clocks).
+never a hang, never silent partial state.  XLA executables are
+process-local and never ride the bundle; instead (ISSUE 14) the meta
+carries the engine's **compiled-key manifest** + lattice digest, and
+``restore()`` precompiles exactly those keys up front — against a warm
+persistent compile cache (``serving_optimization.compile_cache_dir`` /
+``DS_COMPILE_CACHE``) each one is a disk load, so restore-to-first-token
+stays ~flat vs a warm process.  Deliberately NOT captured: telemetry
+latency stamps (process-relative clocks).
 """
 
 from __future__ import annotations

@@ -498,6 +498,15 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
     #: schedule-invariant sampling: per-(uid, position) derived RNG so
     #: sampled output survives handoff/migration tokenwise identical
     keyed_sampling: bool = False
+    # -- recompile-proof cold starts (ISSUE 14) ------------------------
+    #: persistent XLA compile cache directory ("" = off;
+    #: DS_COMPILE_CACHE env overrides) — restored/spawned replicas load
+    #: executables from disk instead of re-compiling the lattice
+    compile_cache_dir: str = ""
+    #: bucket lattice: "" = power-of-two default; "auto:<path>" loads a
+    #: mined lattice artifact (analyze_trace --emit-lattice) or mines a
+    #: raw workload trace at engine build
+    lattice: str = ""
 
     def to_v2_dict(self) -> Dict[str, Any]:
         """The ``serving_optimization`` dict the inference-v2 config
@@ -516,7 +525,9 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
                 "spec_max_draft": self.spec_max_draft,
                 "spec_ngram_min": self.spec_ngram_min,
                 "role": self.role,
-                "keyed_sampling": self.keyed_sampling}
+                "keyed_sampling": self.keyed_sampling,
+                "compile_cache_dir": self.compile_cache_dir,
+                "lattice": self.lattice}
 
 
 class TPUConfig(DeepSpeedConfigModel):

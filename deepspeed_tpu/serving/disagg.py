@@ -83,7 +83,8 @@ class DisaggPool:
                  prefill_factory: Callable[[], FastGenScheduler],
                  decode_factory: Callable[[], FastGenScheduler],
                  on_token: Optional[Callable[[int, int], None]] = None,
-                 handoff_every: int = 4):
+                 handoff_every: int = 4,
+                 manifest: Optional[Dict[str, list]] = None):
         """The factories build the two schedulers (engines must share
         model WEIGHTS for tokenwise-identical continuations and carry
         ``serving.role`` "prefill" / "decode" respectively — the role
@@ -94,7 +95,13 @@ class DisaggPool:
         import means fewer decode-membership changes, so the decode
         pool's async chain breaks once per BATCH instead of once per
         request (TTFT is unaffected — the first token already left the
-        prefill pool; only that request's second token waits)."""
+        prefill pool; only that request's second token waits).
+        ``manifest`` (ISSUE 14): a per-role compiled-key manifest
+        (``{"prefill": [...], "decode": [...]}`` — the
+        :meth:`compiled_manifest` of a previously-running pool); each
+        engine precompiles its role's keys at birth, which against a
+        warm persistent compile cache is a disk load, not a compile —
+        a freshly spawned disagg pool serves its first handoff warm."""
         self.prefill = prefill_factory()
         self.decode = decode_factory()
         for sched, want in ((self.prefill, "prefill"),
@@ -103,6 +110,22 @@ class DisaggPool:
                 raise ValueError(
                     f"DisaggPool needs a role={want!r} scheduler, got "
                     f"role={sched.role!r} (set serving.role)")
+        if manifest:
+            # same gate as ReplicaPool._warm_new_replica: without an
+            # active persistent compile cache the manifest would be
+            # synchronous TRUE compiles at pool birth — stay lazy then
+            from ..inference.v2.compile_cache import active_cache_dir
+            if active_cache_dir() is None:
+                from ..utils.logging import logger
+                logger.info("DisaggPool: no active compile cache — "
+                            "skipping the warm-birth manifest "
+                            "precompile (engines compile lazily)")
+            else:
+                for sched, role in ((self.prefill, "prefill"),
+                                    (self.decode, "decode")):
+                    keys = manifest.get(role) or []
+                    if keys:
+                        sched._engine.precompile_keys(keys)
         self.prefill.enable_handoff_sink()
         self._on_token = on_token
         self._requests: Dict[int, PoolRequest] = {}
@@ -137,6 +160,14 @@ class DisaggPool:
             decode_pages=self.decode._engine.model.kv_config.num_pages,
             keyed=bool(getattr(self.prefill._engine.model,
                                "keyed_sampling", False)))
+
+    def compiled_manifest(self) -> Dict[str, list]:
+        """Per-role compiled-key manifest of this pool — the
+        ``manifest=`` input for spawning the next (warm-born) pool."""
+        return {"prefill": [list(k) for k in
+                            self.prefill._engine.compiled_keys()],
+                "decode": [list(k) for k in
+                           self.decode._engine.compiled_keys()]}
 
     def _bind_backlog_gauge(self) -> None:
         import weakref

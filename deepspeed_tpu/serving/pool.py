@@ -142,11 +142,20 @@ class ReplicaPool:
                  hint_top_k: int = 64,
                  hint_every: int = 4,
                  min_replicas: int = 1,
-                 max_replicas: int = 8):
+                 max_replicas: int = 8,
+                 warm_spawn: bool = True):
         """``factory(label)`` builds one fresh replica (engine +
         scheduler) — also the ``scale_up`` spawn path, so it must
-        return an INDEPENDENT engine per call."""
+        return an INDEPENDENT engine per call.  With ``warm_spawn``
+        (ISSUE 14) every later spawn precompiles the union of the live
+        replicas' compiled-key manifests — exactly the programs fleet
+        traffic actually forms — before joining the pool; against a
+        warm persistent compile cache
+        (``serving_optimization.compile_cache_dir``) those are disk
+        loads, so a scale_up replica is born warm instead of eating
+        its first requests as compile stalls."""
         self._factory = factory
+        self._warm_spawn = bool(warm_spawn)
         self._hint_top_k = int(hint_top_k)
         self._hint_every = max(int(hint_every), 1)
         self.min_replicas = int(min_replicas)
@@ -193,6 +202,8 @@ class ReplicaPool:
                 label = f"r{self._next_label}"
             self._next_label += 1
         sched = self._factory(label)
+        if self._warm_spawn:
+            self._warm_new_replica(sched)
         rep = _Replica(label, sched, self)
         with self._lock:
             self._replicas[label] = rep
@@ -208,6 +219,45 @@ class ReplicaPool:
                                      scale_up=count_scale_up)
         self._flush_orphans()
         return rep
+
+    def compiled_manifest(self) -> List[tuple]:
+        """Union of the live replicas' compiled-key manifests — the
+        programs fleet traffic actually formed, in a stable order."""
+        keys = set()
+        for rep in self._live():
+            try:
+                keys.update(rep.engine.compiled_keys())
+            except Exception:   # noqa: BLE001 — a dying replica is fine
+                continue
+        return sorted(keys, key=repr)
+
+    def _warm_new_replica(self, sched: FastGenScheduler) -> None:
+        """Precompile the fleet's compiled-key manifest on a
+        just-spawned replica (ISSUE 14): a warm persistent compile
+        cache turns these into disk loads, so the spawn joins the pool
+        recompile-proof.  Without an active compile cache the manifest
+        would be TRUE compiles paid synchronously inside scale_up —
+        at exactly the moment the SLO is burning — so cache-less pools
+        keep the lazy prior behavior (join immediately, compile the
+        keys traffic actually forms).  Best-effort — a failure warns
+        and the replica joins cold rather than not at all."""
+        from ..inference.v2.compile_cache import active_cache_dir
+        if active_cache_dir() is None:
+            return
+        manifest = self.compiled_manifest()
+        if not manifest:
+            return
+        try:
+            n = sched._engine.precompile_keys(manifest)
+        except Exception as e:  # noqa: BLE001
+            from ..utils.logging import logger
+            logger.warning("pool: warm spawn precompile failed "
+                           "(%s: %s) — replica joins cold",
+                           type(e).__name__, e)
+            return
+        get_flight_recorder().record("pool.warm_spawn",
+                                     manifest_keys=len(manifest),
+                                     compiled=n)
 
     def scale_up(self, label: Optional[str] = None) -> Optional[str]:
         """Spawn one fresh replica (the SLO ``scale_up`` action).

@@ -116,6 +116,16 @@ FASTGEN_COMPILE_ON_PATH = registry.counter(
     "ds_fastgen_compile_on_path_total",
     "XLA compiles executed on the serving request path")
 
+# -- persistent compile cache (ISSUE 14) -------------------------------------
+FASTGEN_COMPILE_CACHE_HIT = registry.counter(
+    "ds_fastgen_compile_cache_hit_total",
+    "serving executables LOADED from the persistent compile cache "
+    "(disk deserialization instead of an XLA compile)")
+FASTGEN_COMPILE_CACHE_MISS = registry.counter(
+    "ds_fastgen_compile_cache_miss_total",
+    "cache-eligible compiles the persistent compile cache could not "
+    "serve (true XLA compiles, written back to the cache)")
+
 # -- fault injection + self-healing (ISSUE 7) --------------------------------
 CHAOS_INJECTED = registry.counter(
     "ds_chaos_injected_total",

@@ -319,12 +319,22 @@ class Watchdog:
                 return      # warn once per storm
             self._in_compile_storm = True
             keys = list(self._compile_keys)
+        wt = get_workload_trace()
+        trace_hint = ((getattr(wt, "_path", "")
+                       or "<workload-trace.jsonl>")
+                      if wt.active else "<workload-trace.jsonl>")
         self._logger().warning(
             "watchdog: recompile storm on the serving request path — "
             "%d XLA compiles in %.0fs; uncovered (S, Q, P, fresh, kind) "
             "step-cache keys: %s.  Widen precompile()'s lattice to "
-            "cover them (sampling=True for fused sample/chain variants)",
-            len(recent), self.storm_window_s, keys)
+            "cover them (sampling=True for fused sample/chain "
+            "variants), or mine a covering lattice from the workload "
+            "trace: `python tools/analyze_trace.py --trace %s "
+            "--emit-lattice lattice.json` and rebuild the engine with "
+            "serving_optimization.lattice=\"auto:lattice.json\" "
+            "(plus compile_cache_dir/DS_COMPILE_CACHE so later "
+            "processes load, not compile)",
+            len(recent), self.storm_window_s, keys, trace_hint)
 
     # -- health verdicts (/healthz) ------------------------------------------
     def health(self) -> Dict[str, Any]:

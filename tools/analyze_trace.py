@@ -46,35 +46,16 @@ except ImportError:                      # run as a script: tools/ on path
     import replay_trace
 
 
-def fit_buckets(lengths: Sequence[int], ratio: float = 1.3,
-                max_buckets: int = 12, floor: int = 1) -> List[int]:
-    """Quantile-style bucket tops fit to an observed length
-    distribution: greedily group sorted distinct lengths so every
-    length maps to a top within ``ratio``x of itself (each bucket's
-    top is the LARGEST observed length it covers — zero overshoot at
-    the top, bounded overshoot at the bottom).  When that needs more
-    than ``max_buckets`` buckets, the ratio widens until it fits.  A
-    bimodal distribution gets tops at the modes, not at the enclosing
-    powers of two."""
-    # a ratio <= 1 can never merge (and the widening step below can't
-    # grow a non-positive one) — floor it instead of hanging
-    ratio = max(float(ratio), 1.001)
-    vals = sorted({max(int(v), floor) for v in lengths})
-    if not vals:
-        return []
-    while True:
-        buckets: List[int] = []
-        i = 0
-        while i < len(vals):
-            lo = vals[i]
-            j = i
-            while j + 1 < len(vals) and vals[j + 1] <= lo * ratio:
-                j += 1
-            buckets.append(vals[j])
-            i = j + 1
-        if len(buckets) <= max_buckets:
-            return buckets
-        ratio *= 1.25
+# the quantile-fitted bucket boundaries now live IN the package
+# (``inference.v2.lattice``) so engine build can consume them via
+# ``lattice="auto:<path>"`` without importing tools/.  Re-exported
+# LAZILY (PEP 562) for existing callers/tests: an eager import would
+# pull jax + the serving stack into this CLI's import time.
+def __getattr__(name):
+    if name == "fit_buckets":
+        from deepspeed_tpu.inference.v2.lattice import fit_buckets
+        return fit_buckets
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 #: one percentile implementation across the observatory tools
@@ -170,6 +151,7 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
     uncovered = sorted(k for k in occ if k not in current)
 
     # -- recommended lattice ------------------------------------------
+    from deepspeed_tpu.inference.v2.lattice import fit_buckets
     q_buckets = fit_buckets(prompt_lens, ratio=ratio,
                             max_buckets=max_buckets)
     p_buckets = fit_buckets([-(-t // page) for t in total_lens],
@@ -264,12 +246,34 @@ def main(argv=None) -> int:
     ap.add_argument("--max-buckets", type=int, default=12)
     ap.add_argument("--json", default="",
                     help="also write the report to this path")
+    ap.add_argument("--emit-lattice", default="", metavar="PATH",
+                    help="write a versioned lattice artifact (fitted "
+                    "bucket tops + precompile key set + config digest) "
+                    "that engine build consumes via "
+                    "serving_optimization.lattice=\"auto:PATH\" "
+                    "(ISSUE 14); a digest mismatch at load refuses "
+                    "with a structured error, never a silent cold "
+                    "lattice")
     args = ap.parse_args(argv)
 
     trace = replay_trace.load_trace(args.trace)
     report = analyze(trace, max_concurrency=args.max_concurrency,
                      batch_size=args.batch_size, ratio=args.ratio,
                      max_buckets=args.max_buckets)
+    if args.emit_lattice:
+        from deepspeed_tpu.inference.v2 import lattice as dslattice
+        artifact = dslattice.mine_lattice(
+            trace, ratio=args.ratio, max_buckets=args.max_buckets,
+            max_ragged_batch_size=args.batch_size, source=args.trace)
+        dslattice.write_artifact(artifact, args.emit_lattice)
+        report["emitted_lattice"] = {
+            "path": args.emit_lattice,
+            "config_digest": artifact["config_digest"],
+            "keys": len(artifact["keys"]),
+            "s_buckets": artifact["s_buckets"],
+            "q_buckets": artifact["q_buckets"],
+            "p_buckets": artifact["p_buckets"],
+        }
     print(json.dumps(report, indent=1, default=str))
     if args.json:
         with open(args.json, "w") as f:

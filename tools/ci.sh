@@ -30,6 +30,15 @@
 #                      two-pool prefill/decode scheduler with
 #                      committed-page KV streaming handoffs; asserts
 #                      structural parity AND zero lost requests
+#   5c. cold-start smoke — tools/coldstart_smoke.py --check
+#                      (ISSUE 14): process A mines a lattice artifact
+#                      from the checked-in trace, precompiles it into
+#                      a persistent compile cache, and snapshots a
+#                      partially-served run; a COLD process B restores
+#                      with lattice="auto:…" against the warm cache
+#                      and replays — asserting tokenwise parity,
+#                      compile_on_path_total == 0, and ZERO true
+#                      compiles (cache loads only)
 #   6. metric lint   — tools/check_metrics.py (naming convention +
 #                      DESIGN.md documentation + no dead metrics for
 #                      every ds_* metric)
@@ -69,6 +78,9 @@ python tools/fleetctl.py --pool-smoke
 echo "== disaggregated two-pool smoke (KV-streaming handoffs) =="
 python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
     --limit 32 --disagg --check > /dev/null
+
+echo "== cold-start smoke (persistent compile cache + auto lattice) =="
+python tools/coldstart_smoke.py --check --limit 16 > /dev/null
 
 echo "== metric namespace lint =="
 python tools/check_metrics.py

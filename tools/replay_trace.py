@@ -62,32 +62,15 @@ def load_trace(path: str) -> Dict[str, Any]:
     """Parse a workload-trace JSONL ledger into
     ``{"meta", "requests", "compiles", "key_counts"}``.  Records of the
     rotated generation (``<path>.1``) are NOT read — the caller decides
-    whether to concatenate generations."""
-    meta: Dict[str, Any] = {}
-    requests: List[Dict[str, Any]] = []
-    compiles: List[list] = []
-    key_counts: Dict[tuple, int] = {}
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            rec = json.loads(line)
-            kind = rec.get("kind")
-            if kind == "meta" and not meta:
-                meta = rec
-            elif kind == "request":
-                requests.append(rec)
-            elif kind == "compile":
-                compiles.append(rec["key"])
-            elif kind == "keys":
-                for key, n in rec["counts"]:
-                    key_counts[tuple(key)] = (
-                        key_counts.get(tuple(key), 0) + int(n))
-    if not requests:
+    whether to concatenate generations.  The parser itself is the ONE
+    in-package implementation (``inference.v2.lattice.load_trace_facts``
+    — engine build mines raw ledgers through it too); replay
+    additionally requires request records."""
+    from deepspeed_tpu.inference.v2.lattice import load_trace_facts
+    trace = load_trace_facts(path)
+    if not trace["requests"]:
         raise ValueError(f"{path}: no request records")
-    return {"meta": meta, "requests": requests, "compiles": compiles,
-            "key_counts": key_counts}
+    return trace
 
 
 # -- anonymized prompt synthesis ---------------------------------------------
