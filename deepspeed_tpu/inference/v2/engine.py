@@ -585,6 +585,7 @@ class InferenceEngineV2:
                 descs.append(sd)
             return descs
 
+    # dslint: hot-path
     def _commit_batch(self, descs) -> None:
         """Shared put/step epilogue: commit host bookkeeping (the token
         VALUES may still be in flight on device — only counts matter
@@ -884,6 +885,7 @@ class InferenceEngineV2:
             top_ps, greedy_only, row_uids=kuids, row_pos=kpos)
         return out
 
+    # dslint: hot-path
     def commit_spec(self, batch_uids: Sequence[int],
                     committed: Sequence[int]) -> None:
         """Variable-advance commit of a :meth:`step_spec` dispatch:

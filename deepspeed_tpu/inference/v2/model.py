@@ -664,6 +664,7 @@ class RaggedInferenceModel:
 
         return self._norm(params["final_norm"], x), kv
 
+    # dslint: hot-path
     def _step_impl(self, params, kv, token_ids, q_lens, start_pos,
                    page_table, fresh: bool = False):
         cfg = self.cfg
@@ -689,6 +690,7 @@ class RaggedInferenceModel:
         from .sampling import sample_dynamic
         return sample_dynamic(logits, rng, temps, top_ks, top_ps)
 
+    # dslint: hot-path
     def _sample_step_impl(self, params, kv, token_ids, q_lens, start_pos,
                           page_table, rng, temps, top_ks, top_ps,
                           row_uids=None, row_pos=None,
@@ -701,6 +703,7 @@ class RaggedInferenceModel:
                                      row_uids, row_pos, greedy_only)
         return tokens, kv
 
+    # dslint: hot-path
     def _chained_step_impl(self, params, kv, prev_tokens, gather_idx,
                            q_lens, start_pos, page_table, rng, temps,
                            top_ks, top_ps, row_uids=None, row_pos=None,
@@ -714,6 +717,7 @@ class RaggedInferenceModel:
             temps, top_ks, top_ps, row_uids, row_pos,
             fresh=False, greedy_only=greedy_only)
 
+    # dslint: hot-path
     def _spec_step_impl(self, params, kv, token_ids, q_lens, start_pos,
                         page_table, rng, temps, top_ks, top_ps,
                         row_uids=None, row_pos=None,
@@ -768,6 +772,7 @@ class RaggedInferenceModel:
                                         axis=1)[:, 0]
         return jnp.stack([accepts, corrected], axis=1), kv   # [S, 2]
 
+    # dslint: hot-path
     def _mixed_sample_step_impl(self, params, kv, d_tok, d_ql, d_sp,
                                 d_pt, p_tok, p_ql, p_sp, p_pt, rng,
                                 temps, top_ks, top_ps,

@@ -157,7 +157,9 @@ class FaultInjector:
 
     def __init__(self):
         self.armed = False
-        self._lock = threading.Lock()
+        # RLock (dslint telemetry-rlock): fire() can run inside frames
+        # the postmortem SIGTERM handler interrupts and re-enters
+        self._lock = threading.RLock()
         self._seed = 0
         self._specs: Dict[str, FaultSpec] = {}
         self._rngs: Dict[str, random.Random] = {}
@@ -197,6 +199,7 @@ class FaultInjector:
         return self.armed and site in self._specs
 
     # -- the hot-path gate ---------------------------------------------------
+    # dslint: disabled-path
     def fire(self, site: str) -> bool:
         """Should the fault at ``site`` fire on this call?  Disabled
         path: one attribute read."""

@@ -43,7 +43,10 @@ from .registry import get_registry
 from .tracer import get_tracer
 
 _server: Optional[ThreadingHTTPServer] = None
-_lock = threading.Lock()
+# RLock (dslint telemetry-rlock): lifecycle lock shared with the
+# module's stop path — a SIGTERM landing inside start/stop must not
+# deadlock against itself
+_lock = threading.RLock()
 
 #: process-wide prefix-digest provider (ISSUE 12): the live inference
 #: engine binds a weakref'd callable at build (newest engine wins — the

@@ -146,6 +146,7 @@ class TimeSeries:
                 self._on_sample.append(fn)
 
     # -- sampling ------------------------------------------------------------
+    # dslint: disabled-path
     def maybe_sample(self) -> bool:
         """Opportunistic tick (the scheduler-step hook): samples when
         at least ``interval_s`` has passed since the last sample.

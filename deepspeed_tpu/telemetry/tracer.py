@@ -162,6 +162,7 @@ class _Span:
         return False
 
 
+# dslint: disabled-path
 def trace_span(name: str, attrs: Optional[Dict[str, Any]] = None):
     """Context manager recording a named host span when telemetry is
     enabled.  ``attrs`` (an optional plain dict — not kwargs, so the

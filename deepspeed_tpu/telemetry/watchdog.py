@@ -158,6 +158,7 @@ class Watchdog:
         self._record_event("watchdog.overflow_skip", at_step=step)
 
     # -- step-time anomaly detector ------------------------------------------
+    # dslint: disabled-path
     def observe_step_time(self, kind: str, ms: float,
                           step: int = 0) -> None:
         """Feed one step wall time (``kind`` ∈ {train, fastgen}).  After
@@ -234,6 +235,7 @@ class Watchdog:
                 path, e)
 
     # -- goodput accounting --------------------------------------------------
+    # dslint: disabled-path
     def track(self, phase: str):
         """Context manager accumulating wall time into ``phase``
         (one of :data:`GOODPUT_PHASES`).  Disabled: a shared no-op, no

@@ -1,0 +1,504 @@
+"""dslint — the repo-native static contract checker (ISSUE 15).
+
+One seeded-violation fixture per rule (a temp module with a planted
+contract break, proving the rule FIRES) plus the clean-tree
+acceptance: ``run_all()`` over the real repo reports zero findings
+with the empty checked-in baseline.  Framework units cover the
+suppression vocabulary (reason required, block coverage), the d2h
+annotation cross-check, and baseline matching/staleness.
+"""
+
+import ast
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                         ".."))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.dslint import run_all, PASSES, RULE_TO_PASS          # noqa: E402
+from tools.dslint import (catalog, config_parity, core,         # noqa: E402
+                          disabled_path, hotpath, locks)
+
+
+def _project(tmp_path, files, docs=None):
+    """Build a fixture production tree and load it as a Project."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    for rel, text in (docs or {}).items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return core.Project(str(tmp_path))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# pass 1: hot-path d2h/sync lint
+# ---------------------------------------------------------------------------
+HOT = "deepspeed_tpu/inference/v2/sched_fixture.py"
+
+
+def test_hotpath_sync_fires_on_planted_d2h(tmp_path):
+    proj = _project(tmp_path, {HOT: """
+        import numpy as np
+
+        class S:
+            # dslint: hot-path
+            def _drain_impl(self):
+                toks = np.asarray(self.inflight.tokens_dev)  # planted
+                return toks
+
+            def cold_path(self):
+                # identical code outside the annotation: not linted
+                return np.asarray(self.inflight.tokens_dev)
+        """})
+    found = hotpath.run(proj, required=())
+    assert _rules(found) == ["hot-path-sync"]
+    (f,) = found
+    assert f.path == HOT and "np.asarray" in f.message
+
+
+def test_hotpath_flags_casts_and_syncs_not_host_literals(tmp_path):
+    proj = _project(tmp_path, {HOT: """
+        import numpy as np, jax, jax.numpy as jnp
+
+        class S:
+            # dslint: hot-path
+            def _step_impl(self, x_dev, rows):
+                a = np.asarray([1, 2], np.int32)     # host literal: ok
+                b = int(rows[0])                     # host subscript: ok
+                c = float(jnp.sum(x_dev))            # forces sync: flag
+                d = x_dev.item()                     # flag
+                e = jax.device_get(x_dev)            # flag
+                f = x_dev.block_until_ready()        # flag
+                return a, b, c, d, e, f
+        """})
+    found = hotpath.run(proj, required=())
+    assert _rules(found) == ["hot-path-sync"]
+    assert len(found) == 4
+
+
+def test_hotpath_d2h_annotation_allows_documented_shape(tmp_path):
+    src = """
+        import numpy as np
+
+        class S:
+            # dslint: hot-path
+            def _drain_impl(self):
+                return np.asarray(self.toks_dev)  # dslint: d2h [S] int32
+        """
+    proj = _project(tmp_path, {HOT: src},
+                    docs={"docs/DESIGN.md": "contract: `[S] int32`"})
+    assert hotpath.run(proj, required=()) == []
+    # same annotation, shape NOT in the design doc -> shape rule fires
+    proj2 = _project(tmp_path / "b", {HOT: src},
+                     docs={"docs/DESIGN.md": "no contract here"})
+    found = hotpath.run(proj2, required=())
+    assert _rules(found) == ["hot-path-d2h-shape"]
+    # with a transfer-contract SECTION present, a shape mentioned only
+    # in unrelated prose does not legitimize the transfer
+    proj3 = _project(tmp_path / "c", {HOT: src}, docs={
+        "docs/DESIGN.md": "prose mentions `[S] int32` here\n"
+                          "### The transfer contract\n- `[S, 2] int32`\n"
+                          "## Next section\n"})
+    found = hotpath.run(proj3, required=())
+    assert _rules(found) == ["hot-path-d2h-shape"]
+    # and inside the section it passes
+    proj4 = _project(tmp_path / "d", {HOT: src}, docs={
+        "docs/DESIGN.md": "### The transfer contract\n- `[S] int32`\n"
+                          "## Next section\nother prose\n"})
+    assert hotpath.run(proj4, required=()) == []
+
+
+def test_hotpath_required_coverage(tmp_path):
+    proj = _project(tmp_path, {HOT: """
+        class S:
+            def _drain_impl(self):
+                return 1
+        """})
+    found = hotpath.run(proj, required=((HOT, r"^_drain_impl$"),))
+    assert _rules(found) == ["hot-path-missing"]
+    # a renamed/vanished contract function also fails
+    found = hotpath.run(proj, required=((HOT, r"^_gone_impl$"),))
+    assert _rules(found) == ["hot-path-missing"]
+    assert "no function matches" in found[0].message
+
+
+def test_hotpath_block_suppression_covers_with_body(tmp_path):
+    proj = _project(tmp_path, {HOT: """
+        import numpy as np
+
+        class S:
+            # dslint: hot-path
+            def _step_impl(self):
+                # dslint: disable=hot-path-sync -- split escape hatch
+                with self.span():
+                    t = np.asarray(self.logits_dev)
+                return t
+        """})
+    assert hotpath.run(proj, required=()) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2: config parity
+# ---------------------------------------------------------------------------
+CFG_A = """
+class ServingOptimizationConfig(Model):
+    enabled: bool = True
+    fused_step: bool = True
+    max_queue_depth: int = 0
+
+    def to_v2_dict(self):
+        return {"enabled": self.enabled, "fused_step": self.fused_step,
+                "max_queue_depth": self.max_queue_depth}
+"""
+
+
+def test_config_parity_clean_and_drift():
+    ok = ast.parse(textwrap.dedent("""
+        class ServingOptimizationConfig:
+            fused_step: bool = True
+            max_queue_depth: int = 0
+        """))
+    a = ast.parse(textwrap.dedent(CFG_A))
+    assert config_parity.compare_pair(
+        a, ok, "ServingOptimizationConfig", frozenset({"enabled"}),
+        frozenset(), "a.py", "b.py") == []
+    # planted drift: missing field on one side + default mismatch
+    drift = ast.parse(textwrap.dedent("""
+        class ServingOptimizationConfig:
+            fused_step: bool = False
+        """))
+    found = config_parity.compare_pair(
+        a, drift, "ServingOptimizationConfig", frozenset({"enabled"}),
+        frozenset(), "a.py", "b.py")
+    details = sorted(f.detail for f in found)
+    assert details == [
+        "ServingOptimizationConfig.fused_step:default",
+        "ServingOptimizationConfig.max_queue_depth:missing"]
+
+
+def test_config_parity_to_v2_dict_closure():
+    a = ast.parse(textwrap.dedent(CFG_A))
+    assert config_parity.check_to_v2_dict(
+        a, "ServingOptimizationConfig", "a.py") == []
+    # planted: a field dropped from the dict + a cross-wired value
+    bad = ast.parse(textwrap.dedent("""
+        class ServingOptimizationConfig:
+            enabled: bool = True
+            fused_step: bool = True
+
+            def to_v2_dict(self):
+                return {"enabled": self.fused_step}
+        """))
+    found = config_parity.check_to_v2_dict(
+        bad, "ServingOptimizationConfig", "a.py")
+    details = sorted(f.detail for f in found)
+    assert details == [
+        "ServingOptimizationConfig.enabled:to_v2_dict-value",
+        "ServingOptimizationConfig.fused_step:to_v2_dict"]
+
+
+def test_config_parity_factory_defaults_normalize():
+    a = ast.parse("class TelemetryConfig:\n"
+                  "    slo: list = Field(default_factory=list)\n")
+    b = ast.parse("class TelemetryConfig:\n"
+                  "    slo: list = dataclasses.field("
+                  "default_factory=list)\n")
+    assert config_parity.compare_pair(
+        a, b, "TelemetryConfig", frozenset(), frozenset(),
+        "a.py", "b.py") == []
+
+
+# ---------------------------------------------------------------------------
+# pass 3: lock discipline
+# ---------------------------------------------------------------------------
+TEL = "deepspeed_tpu/telemetry/fixture_mod.py"
+
+
+def test_lock_rules_fire_on_planted_bugs(tmp_path):
+    proj = _project(tmp_path, {TEL: """
+        import threading, time
+        from urllib.request import urlopen
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()     # planted: not RLock
+
+            def scrape(self):
+                with self._lock:
+                    return urlopen("http://x/metrics")  # planted
+
+            def save(self):
+                with self._lock:
+                    self._helper()                # I/O one call deep
+
+            def _helper(self):
+                with open("/tmp/x", "w") as f:
+                    f.write("x")
+        """})
+    found = locks.run(proj)
+    assert _rules(found) == ["lock-held-io", "telemetry-rlock"]
+    io = [f for f in found if f.rule == "lock-held-io"]
+    assert {f.detail for f in io} == {"scrape:urlopen()",
+                                      "_helper:open()"}
+    assert any("via _helper()" in f.message for f in io)
+
+
+def test_lock_io_suppression_on_io_line(tmp_path):
+    proj = _project(tmp_path, {TEL: """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def rotate(self):
+                with self._lock:
+                    # dslint: disable=lock-held-io -- append-only ledger
+                    self._fh = open("/tmp/x", "a")
+        """})
+    assert locks.run(proj) == []
+
+
+def test_lock_rules_scoped_to_telemetry_modules(tmp_path):
+    proj = _project(tmp_path, {
+        "deepspeed_tpu/serving/other.py": """
+        import threading
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()   # out of scope
+        """})
+    assert locks.run(proj) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 4: disabled-path cost
+# ---------------------------------------------------------------------------
+def test_disabled_path_guard_shapes(tmp_path):
+    proj = _project(tmp_path, {TEL: '''
+        class T:
+            # dslint: disabled-path
+            def good(self, name):
+                """Disabled path: one attribute read."""
+                if not self.enabled:
+                    return None
+                return self.do(name)
+
+            # dslint: disabled-path
+            def allocates_first(self, name):
+                label = f"span:{name}"          # planted: pre-guard work
+                if not self.enabled:
+                    return None
+                return self.do(label)
+
+            # dslint: disabled-path
+            def calls_in_guard(self, name):
+                if not self.state().enabled:    # planted: call in guard
+                    return None
+                return self.do(name)
+        '''})
+    found = disabled_path.run(proj, required=())
+    assert _rules(found) == ["disabled-path-guard"]
+    assert sorted(f.detail for f in found) == ["allocates_first",
+                                               "calls_in_guard"]
+
+
+def test_disabled_path_required_module_coverage(tmp_path):
+    proj = _project(tmp_path, {TEL: """
+        class T:
+            def record(self):
+                return 1
+        """})
+    found = disabled_path.run(proj, required=(TEL,))
+    assert _rules(found) == ["disabled-path-guard"]
+    assert found[0].detail == "no-annotation"
+
+
+# ---------------------------------------------------------------------------
+# pass 5: catalog closure
+# ---------------------------------------------------------------------------
+FI = "deepspeed_tpu/runtime/fault_injection.py"
+FR = "deepspeed_tpu/telemetry/flight_recorder.py"
+
+
+def test_chaos_site_closure(tmp_path):
+    proj = _project(tmp_path, {
+        FI: """
+        SITES = {"train.nan_grad": "x", "kv.alloc_oom": "y",
+                 "never.used": "z"}
+        """,
+        "deepspeed_tpu/runtime/engine.py": """
+        def step(fi):
+            fi.fire("train.nan_grad")
+            fi.maybe_raise("kv.alloc_oom", ValueError)
+            fi.fire("train.typo_grad")   # planted: unknown site
+        """})
+    found = catalog.check_chaos_sites(proj)
+    assert sorted(f.detail for f in found) == ["dead:never.used",
+                                               "unknown:train.typo_grad"]
+
+
+def test_flight_event_closure(tmp_path):
+    proj = _project(tmp_path, {
+        FR: """
+        EVENT_KINDS = frozenset({"request.done", "never.recorded"})
+
+        class FlightRecorder:
+            def record(self, event, **fields):
+                pass
+        """,
+        "deepspeed_tpu/inference/v2/scheduler.py": """
+        def finish(rec):
+            rec.record("request.done", uid=1)
+            rec.record("request.tpyo", uid=2)   # planted: unregistered
+        """})
+    found = catalog.check_flight_events(proj)
+    assert sorted(f.detail for f in found) == ["dead:never.recorded",
+                                               "unknown:request.tpyo"]
+
+
+def test_env_doc_closure(tmp_path):
+    proj = _project(tmp_path, {
+        "deepspeed_tpu/utils/env_fixture.py": """
+        import os
+
+        DOCUMENTED = os.environ.get("DS_DOCUMENTED", "")
+        PLANTED = os.getenv("DS_UNDOCUMENTED")
+        ALSO = os.environ["DS_SUBSCRIPTED"]
+        FLAG = "DS_MEMBERSHIP" in os.environ
+        """},
+        docs={"docs/DESIGN.md": "`DS_DOCUMENTED` does things",
+              "README.md": "see DS_SUBSCRIPTED"})
+    found = catalog.check_env_docs(proj)
+    assert sorted(f.detail for f in found) == ["DS_MEMBERSHIP",
+                                               "DS_UNDOCUMENTED"]
+
+
+def test_env_doc_rejects_prefix_rides(tmp_path):
+    """DS_WORKLOAD must not pass because DS_WORKLOAD_TRACE is
+    documented — matching is word-boundary, not substring."""
+    proj = _project(tmp_path, {
+        "deepspeed_tpu/utils/env_fixture.py": """
+        import os
+        A = os.getenv("DS_WORKLOAD")
+        B = os.getenv("DS_WORKLOAD_TRACE")
+        """},
+        docs={"docs/DESIGN.md": "`DS_WORKLOAD_TRACE` is the ledger"})
+    found = catalog.check_env_docs(proj)
+    assert [f.detail for f in found] == ["DS_WORKLOAD"]
+
+
+# ---------------------------------------------------------------------------
+# framework: suppression vocabulary + baseline
+# ---------------------------------------------------------------------------
+def test_bare_suppression_is_a_finding_and_does_not_suppress(tmp_path):
+    proj = _project(tmp_path, {TEL: """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()  # dslint: disable=telemetry-rlock
+        """})
+    sf = proj.file(TEL)
+    assert [f.rule for f in sf.comment_findings] == ["bare-suppression"]
+    # and the reasonless disable did NOT silence the underlying rule
+    assert _rules(locks.run(proj)) == ["telemetry-rlock"]
+
+
+def test_unknown_rule_suppression_is_flagged(tmp_path):
+    proj = _project(tmp_path, {TEL: """
+        x = 1  # dslint: disable=not-a-rule -- because
+        """})
+    sf = proj.file(TEL)
+    assert [f.rule for f in sf.comment_findings] == ["bare-suppression"]
+    assert "unknown rule" in sf.comment_findings[0].message
+
+
+def test_reasoned_suppression_silences(tmp_path):
+    proj = _project(tmp_path, {TEL: """
+        import threading
+
+        class R:
+            def __init__(self):
+                # dslint: disable=telemetry-rlock -- provably handler-free
+                self._lock = threading.Lock()
+        """})
+    assert proj.file(TEL).comment_findings == []
+    assert locks.run(proj) == []
+
+
+def test_baseline_matching_and_staleness(tmp_path):
+    f1 = core.Finding("env-doc", "a.py", 10, "msg", detail="DS_X")
+    f2 = core.Finding("env-doc", "b.py", 3, "msg", detail="DS_Y")
+    entries = [
+        {"rule": "env-doc", "path": "a.py", "detail": "DS_X",
+         "reason": "legacy knob, removal tracked"},
+        {"rule": "env-doc", "path": "gone.py", "detail": "DS_Z",
+         "reason": "stale"},
+    ]
+    new, old, stale = core.apply_baseline([f1, f2], entries)
+    assert [f.detail for f in new] == ["DS_Y"]
+    assert [f.detail for f in old] == ["DS_X"]
+    assert [e["detail"] for e in stale] == ["DS_Z"]
+    # baseline entries without a reason are format errors
+    bad = tmp_path / "baseline.json"
+    bad.write_text(json.dumps({"findings": [
+        {"rule": "env-doc", "path": "a.py", "detail": "DS_X"}]}))
+    _entries, errors = core.load_baseline(str(bad))
+    assert errors and "reason" in errors[0]
+
+
+def test_checked_in_baseline_is_empty():
+    path = os.path.join(REPO_ROOT, core.DEFAULT_BASELINE)
+    entries, errors = core.load_baseline(path)
+    assert errors == [] and entries == []
+
+
+# ---------------------------------------------------------------------------
+# the acceptance criterion: the production tree is finding-free
+# ---------------------------------------------------------------------------
+def test_clean_tree_fast_passes():
+    """Every pure-AST pass over the real repo: zero findings (the
+    catalog pass — which imports the live metric registry — is the
+    slower half, exercised below and by ci.sh)."""
+    report = run_all(root=REPO_ROOT, skip=["catalog"])
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings)
+    assert report.stale_baseline == []
+
+
+def test_clean_tree_catalog_pass():
+    report = run_all(root=REPO_ROOT, only=["catalog"])
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings)
+
+
+def test_cli_and_registry():
+    from tools.dslint.__main__ import main
+    assert main(["--list-rules"]) == 0
+    assert main(["--only", "bogus-pass"]) == 2
+    # every advertised rule maps to a registered pass
+    assert set(RULE_TO_PASS.values()) <= set(PASSES)
+    assert set(RULE_TO_PASS) <= core.RULE_IDS
+
+
+def test_check_metrics_shim_surface():
+    """The transitional shim keeps the historical module surface."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    import check_metrics
+    assert check_metrics.check() == []
+    assert check_metrics.NAME_RE.match("ds_serving_steps_total")
+    assert not check_metrics.NAME_RE.match("serving_steps")

@@ -1,132 +1,39 @@
 #!/usr/bin/env python
-"""Metric-namespace lint (ISSUE 4 CI satellite; ISSUE 9 dead-metric
-pass).
+"""Metric-namespace lint — thin CLI shim (ISSUE 15).
 
-Asserts that every metric registered in the telemetry registry
-
-- matches the ``ds_<area>_<name>`` naming convention with a known area
-  (counters additionally end in ``_total``),
-- is documented in docs/DESIGN.md's "Telemetry" metric table, and
-- is actually RECORDED somewhere in the production tree (a
-  ``.inc(`` / ``.observe(`` / ``.set(`` / ``.bind(`` on the minted
-  object outside ``telemetry/metrics.py``) — a metric minted but never
-  fed is a dead series that scrapes as a forever-zero and rots the
-  dashboard,
-
-so the namespace cannot silently drift: adding a metric without
-documenting it (or with an off-convention name, or without wiring a
-recording site) fails tier-1 (tests/test_telemetry.py runs
-:func:`check`) and this script (``python tools/check_metrics.py``)
-exits non-zero.
+The implementation moved into the dslint framework
+(``tools/dslint/metrics_catalog.py``, run in CI as dslint's
+``metric-catalog`` rule).  This shim keeps the historical CLI and
+module surface — ``check()`` returning message strings, ``NAME_RE``,
+``AREAS``, exit code 1 on any error — so ``tools/ci.sh`` and
+tests/test_telemetry.py keep working during the transition.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(_TOOLS)
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
 
-AREAS = ("serving", "comm", "kv", "train", "fastgen", "chaos",
-         "fleet", "slo", "telemetry", "pool", "disagg")
-NAME_RE = re.compile(
-    r"^ds_(%s)_[a-z][a-z0-9_]*$" % "|".join(AREAS))
+from tools.dslint import metrics_catalog as _impl            # noqa: E402
+from tools.dslint.metrics_catalog import (AREAS,             # noqa: F401,E402
+                                          NAME_RE, RECORD_METHODS,
+                                          SCAN_ROOTS)
 
-#: where metric objects are minted — excluded from the recording scan
-CATALOG = os.path.join("deepspeed_tpu", "telemetry", "metrics.py")
-#: the production tree the recording scan walks (tests are deliberately
-#: excluded: a metric recorded only by its test is still dead)
-SCAN_ROOTS = ("deepspeed_tpu", "tools", "bench.py")
-#: a minted identifier counts as recorded when one of these is called
-#: on it anywhere in the scanned tree
-RECORD_METHODS = ("inc", "observe", "set", "bind")
+#: module-level seams kept monkeypatchable (the catalog-relocation
+#: test seam from ISSUE 9) — read at call time, not import time
+CATALOG = _impl.CATALOG
 
 
-def _minted_identifiers() -> Dict[str, str]:
-    """{metric name: python identifier} parsed from the catalog."""
-    path = os.path.join(REPO_ROOT, CATALOG)
-    with open(path) as f:
-        src = f.read()
-    out: Dict[str, str] = {}
-    for m in re.finditer(
-            r"^(?P<ident>[A-Z][A-Z0-9_]*) = registry\.\w+\(\s*\n?\s*"
-            r"\"(?P<name>ds_[a-z0-9_]+)\"", src, re.MULTILINE):
-        out[m.group("name")] = m.group("ident")
-    return out
-
-
-def _scan_recordings() -> str:
-    """Concatenated source of every production .py file outside the
-    catalog (one pass; the per-metric check is a regex over it)."""
-    chunks: List[str] = []
-    for root in SCAN_ROOTS:
-        full = os.path.join(REPO_ROOT, root)
-        if os.path.isfile(full):
-            with open(full) as f:
-                chunks.append(f.read())
-            continue
-        for dirpath, _dirs, files in os.walk(full):
-            if "__pycache__" in dirpath:
-                continue
-            for name in files:
-                if not name.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, name)
-                if path.endswith(CATALOG):
-                    continue
-                with open(path) as f:
-                    chunks.append(f.read())
-    return "\n".join(chunks)
-
-
-def check(design_path: str = None) -> List[str]:
-    """Return a list of lint errors (empty = clean)."""
-    if REPO_ROOT not in sys.path:
-        sys.path.insert(0, REPO_ROOT)
-    from deepspeed_tpu.telemetry import Counter, get_registry
-    from deepspeed_tpu.telemetry import metrics  # noqa: F401 — mint catalog
-
-    if design_path is None:
-        design_path = os.path.join(REPO_ROOT, "docs", "DESIGN.md")
-    with open(design_path) as f:
-        design = f.read()
-
-    errors = []
-    registered = get_registry().all_metrics()
-    if not registered:
-        errors.append("no metrics registered — catalog import broken?")
-    idents = _minted_identifiers()
-    source = _scan_recordings()
-    for name, metric in sorted(registered.items()):
-        if not NAME_RE.match(name):
-            errors.append(
-                f"{name}: does not match ds_<area>_<name> "
-                f"(area in {AREAS}, lowercase [a-z0-9_])")
-        if isinstance(metric, Counter) and not name.endswith("_total"):
-            errors.append(f"{name}: counters must end in _total")
-        if f"`{name}`" not in design:
-            errors.append(
-                f"{name}: not documented in docs/DESIGN.md "
-                "(add a row to the Telemetry metric table)")
-        if not metric.help:
-            errors.append(f"{name}: registered without help text")
-        # dead-metric pass (ISSUE 9): minted in the catalog but never
-        # fed anywhere in the production tree.  Metrics registered
-        # OUTSIDE the catalog (tests minting throwaways) are skipped —
-        # the naming/docs lints above already police them.
-        ident = idents.get(name)
-        if ident is not None and not re.search(
-                r"\b%s\s*\.\s*(%s)\s*\(" % (ident,
-                                            "|".join(RECORD_METHODS)),
-                source):
-            errors.append(
-                f"{name}: dead metric — minted as {ident} in "
-                f"{CATALOG} but never recorded "
-                f"(.{'/.'.join(RECORD_METHODS)}) anywhere in "
-                f"{SCAN_ROOTS}")
-    return errors
+def check(design_path: str = None):
+    """List of lint error strings (empty = clean); delegates to
+    tools/dslint/metrics_catalog with this module's seams."""
+    return _impl.check(design_path=design_path, repo_root=REPO_ROOT,
+                       catalog=CATALOG)
 
 
 def main() -> int:

@@ -1,6 +1,13 @@
 #!/usr/bin/env bash
 # Single CI entrypoint (ISSUE 8 satellite).  Runs, in order:
 #
+#   0. dslint        — tools/dslint static contract checks (ISSUE 15):
+#                      hot-path d2h/sync lint, config parity, lock
+#                      discipline, disabled-path cost, catalog closure
+#                      (metrics + chaos sites + flight events + DS_*
+#                      env docs).  Strict: any unsuppressed finding or
+#                      stale baseline entry fails BEFORE the test
+#                      tiers, so a contract break is named fast
 #   1. tier-1        — the ROADMAP verify tier (-m 'not slow'; includes
 #                      the heavy tier and the chaos suite)
 #   2. chaos tier    — every fault-injection test alone (-m chaos), so
@@ -39,10 +46,7 @@
 #                      and replays — asserting tokenwise parity,
 #                      compile_on_path_total == 0, and ZERO true
 #                      compiles (cache loads only)
-#   6. metric lint   — tools/check_metrics.py (naming convention +
-#                      DESIGN.md documentation + no dead metrics for
-#                      every ds_* metric)
-#   7. bench gate    — tools/check_bench.py --strict (latest vs
+#   6. bench gate    — tools/check_bench.py --strict (latest vs
 #                      previous BENCH_r*.json; throughput -10% /
 #                      latency +15% tolerances, cross-backend rounds
 #                      downgraded to notes, fleet keys ±30/40%)
@@ -56,6 +60,9 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 TIMEOUT="${DS_CI_TIMEOUT:-870}"
+
+echo "== dslint static contract checks =="
+python -m tools.dslint --strict
 
 echo "== tier-1 (timeout ${TIMEOUT}s) =="
 timeout -k 10 "$TIMEOUT" python -m pytest tests/ -q -m 'not slow' \
@@ -82,8 +89,9 @@ python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
 echo "== cold-start smoke (persistent compile cache + auto lattice) =="
 python tools/coldstart_smoke.py --check --limit 16 > /dev/null
 
-echo "== metric namespace lint =="
-python tools/check_metrics.py
+# (the former standalone metric-lint leg is leg 0's metric-catalog
+# rule now; tools/check_metrics.py remains as a local/CI-transition
+# shim over the same implementation)
 
 echo "== bench regression gate =="
 python tools/check_bench.py --strict

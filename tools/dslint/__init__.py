@@ -1,0 +1,87 @@
+"""dslint — the repo-native static contract checker (ISSUE 15).
+
+Five passes over the production tree, each encoding a written
+contract; see docs/DESIGN.md "Static contracts" for the rule table.
+
+    python -m tools.dslint [--strict] [--only RULES] [--skip RULES]
+
+Library entry point: :func:`run_all` -> :class:`~tools.dslint.core.Report`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .core import (DEFAULT_BASELINE, RULE_IDS, Finding,  # noqa: F401
+                   Project, Report, SourceFile, apply_baseline,
+                   load_baseline)
+from . import hotpath, config_parity, locks, disabled_path, catalog
+
+#: name -> pass entry point, in report order.  Importing the modules
+#: above also registers every rule id, so suppression validation in
+#: core sees the full vocabulary before any file parses.
+PASSES: Dict[str, Callable[[Project], List[Finding]]] = {
+    "hotpath": hotpath.run,
+    "config-parity": config_parity.run,
+    "locks": locks.run,
+    "disabled-path": disabled_path.run,
+    "catalog": catalog.run,
+}
+
+#: rule id -> owning pass name (for --only/--skip by rule id)
+RULE_TO_PASS: Dict[str, str] = {
+    "hot-path-sync": "hotpath", "hot-path-d2h-shape": "hotpath",
+    "hot-path-missing": "hotpath",
+    "config-parity": "config-parity",
+    "telemetry-rlock": "locks", "lock-held-io": "locks",
+    "disabled-path-guard": "disabled-path",
+    "metric-catalog": "catalog", "chaos-site": "catalog",
+    "flight-event": "catalog", "env-doc": "catalog",
+}
+
+
+def _select(only: Optional[Sequence[str]],
+            skip: Optional[Sequence[str]]) -> List[str]:
+    names = list(PASSES)
+    alias = dict(RULE_TO_PASS)
+    if only:
+        wanted = {alias.get(n, n) for n in only}
+        names = [n for n in names if n in wanted]
+    if skip:
+        dropped = {alias.get(n, n) for n in skip}
+        names = [n for n in names if n not in dropped]
+    return names
+
+
+def run_all(root: Optional[str] = None,
+            baseline_path: Optional[str] = None,
+            only: Optional[Sequence[str]] = None,
+            skip: Optional[Sequence[str]] = None) -> Report:
+    """Run the selected passes and fold in the baseline.  ``root``
+    defaults to the repo; ``baseline_path=''`` disables the baseline
+    entirely (every finding reports as new)."""
+    import os
+    from .core import REPO_ROOT
+    root = root or REPO_ROOT
+    project = Project(root)
+    findings: List[Finding] = list(project.parse_findings)
+    for sf in project.files():
+        findings.extend(sf.comment_findings)
+    for name in _select(only, skip):
+        findings.extend(PASSES[name](project))
+
+    if baseline_path is None:
+        baseline_path = os.path.join(root, DEFAULT_BASELINE)
+    # dedup: one I/O line reachable from several lock blocks (or one
+    # defect seen by overlapping sub-checks) reports once
+    seen = set()
+    findings = [f for f in findings
+                if (k := (f.rule, f.path, f.line, f.detail)) not in seen
+                and not seen.add(k)]
+
+    entries, errors = ([], []) if baseline_path == "" else \
+        load_baseline(baseline_path)
+    new, old, stale = apply_baseline(findings, entries)
+    new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(findings=new, baselined=old, stale_baseline=stale,
+                  baseline_errors=errors)

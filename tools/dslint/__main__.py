@@ -1,0 +1,92 @@
+"""CLI: ``python -m tools.dslint`` (run from the repo root).
+
+Exit codes: 0 clean, 1 findings (or, with ``--strict``, stale baseline
+entries), 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.dslint import (DEFAULT_BASELINE, PASSES, RULE_TO_PASS,  # noqa: E402
+                          run_all)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dslint",
+        description="repo-native static contract checker")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries (the "
+                    "debt ledger may only shrink); CI runs this")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default {DEFAULT_BASELINE}; "
+                    "'' disables)")
+    ap.add_argument("--only", default="",
+                    help="comma-separated pass or rule names to run")
+    ap.add_argument("--skip", default="",
+                    help="comma-separated pass or rule names to skip")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule ids and owning passes, then exit")
+    ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, owner in sorted(RULE_TO_PASS.items()):
+            print(f"{rule:24s} ({owner})")
+        print("framework rules: bare-suppression, parse-error")
+        return 0
+
+    known = set(PASSES) | set(RULE_TO_PASS)
+    only = [s for s in args.only.split(",") if s]
+    skip = [s for s in args.skip.split(",") if s]
+    for name in only + skip:
+        if name not in known:
+            print(f"dslint: unknown pass/rule {name!r} "
+                  f"(known: {sorted(known)})", file=sys.stderr)
+            return 2
+
+    try:
+        report = run_all(root=args.root, baseline_path=args.baseline,
+                         only=only or None, skip=skip or None)
+    except Exception as e:
+        print(f"dslint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    for err in report.baseline_errors:
+        print(f"dslint: baseline error: {err}", file=sys.stderr)
+    for f in report.findings:
+        print(f"dslint: {f.format()}", file=sys.stderr)
+    for e in report.stale_baseline:
+        print("dslint: stale baseline entry "
+              f"{e.get('rule')}::{e.get('path')}::{e.get('detail')} — "
+              "the finding no longer exists; remove it",
+              file=sys.stderr)
+
+    failed = bool(report.findings or report.baseline_errors)
+    if args.strict and report.stale_baseline:
+        failed = True
+    if failed:
+        n = len(report.findings)
+        print(f"dslint: {n} finding(s)"
+              + (f", {len(report.stale_baseline)} stale baseline "
+                 "entr(ies)" if report.stale_baseline else ""),
+              file=sys.stderr)
+        return 1
+    suffix = (f" ({len(report.baselined)} baselined)"
+              if report.baselined else "")
+    print(f"dslint: clean{suffix}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

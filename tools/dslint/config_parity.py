@@ -1,0 +1,200 @@
+"""Pass 2 — config parity (rule ``config-parity``).
+
+The engine has two config surfaces: the training-side pydantic models
+in ``runtime/config.py`` and the serving-side dataclasses in
+``inference/v2/config.py``.  Three blocks are mirrored by hand every
+PR — ``serving_optimization``, ``telemetry``, ``fault_injection`` —
+and a field added to one but not the other silently becomes a knob
+that half the stack ignores.  This pass compares the mirrored classes
+structurally (pure AST, no imports):
+
+- field SETS must match (modulo a per-pair allowed-extra set: the
+  runtime ``ServingOptimizationConfig.enabled`` master escape hatch is
+  consumed by ``from_dict`` rather than mirrored),
+- field DEFAULTS must match (``Field(default_factory=X)`` and
+  ``dataclasses.field(default_factory=X)`` normalize to the same
+  spelling),
+- every runtime ``ServingOptimizationConfig`` field must survive
+  ``to_v2_dict`` (key present, value ``self.<same name>``) — the
+  bridge every serving engine build rides.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, register_rules
+
+register_rules("config-parity")
+
+RUNTIME_CONFIG = "deepspeed_tpu/runtime/config.py"
+V2_CONFIG = "deepspeed_tpu/inference/v2/config.py"
+
+#: (class name, extras allowed on the runtime side, extras allowed on
+#: the v2 side)
+PAIRS: Tuple[Tuple[str, frozenset, frozenset], ...] = (
+    # `enabled` is the master escape hatch: from_dict consumes it to
+    # flip the per-flag defaults, it is not a mirrored field
+    ("ServingOptimizationConfig", frozenset({"enabled"}), frozenset()),
+    ("TelemetryConfig", frozenset(), frozenset()),
+    ("FaultInjectionConfig", frozenset(), frozenset()),
+)
+
+
+def _normalize_default(node: Optional[ast.expr]) -> str:
+    """Comparable spelling of a field default: factory calls collapse
+    to ``factory:<fn>`` whether spelled ``Field(default_factory=X)``
+    or ``dataclasses.field(default_factory=X)``."""
+    if node is None:
+        return "<required>"
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            getattr(fn, "id", "")
+        if name in ("Field", "field"):
+            for kw in node.keywords:
+                if kw.arg == "default_factory":
+                    return f"factory:{ast.unparse(kw.value)}"
+            if node.args:
+                return ast.unparse(node.args[0])
+            return "<field()>"
+    return ast.unparse(node)
+
+
+def class_fields(tree: ast.AST, cls_name: str
+                 ) -> Optional[Dict[str, str]]:
+    """{field: normalized default} of a class's annotated assignments
+    (the shape both pydantic models and dataclasses share); None when
+    the class is absent."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            fields: Dict[str, str] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name) and \
+                        not stmt.target.id.startswith("_"):
+                    fields[stmt.target.id] = _normalize_default(
+                        stmt.value)
+            return fields
+    return None
+
+
+def to_v2_dict_keys(tree: ast.AST, cls_name: str
+                    ) -> Optional[Dict[str, str]]:
+    """{key: value source} of the dict literal ``to_v2_dict`` returns,
+    or None when class/method/dict-literal-return is absent."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == cls_name):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.FunctionDef) and \
+                    stmt.name == "to_v2_dict":
+                for ret in ast.walk(stmt):
+                    if isinstance(ret, ast.Return) and \
+                            isinstance(ret.value, ast.Dict):
+                        out = {}
+                        for k, v in zip(ret.value.keys,
+                                        ret.value.values):
+                            if isinstance(k, ast.Constant) and \
+                                    isinstance(k.value, str):
+                                out[k.value] = ast.unparse(v)
+                        return out
+    return None
+
+
+def compare_pair(tree_a: ast.AST, tree_b: ast.AST, cls: str,
+                 extra_a: frozenset, extra_b: frozenset,
+                 path_a: str, path_b: str) -> List[Finding]:
+    """Parity findings for one mirrored class (exposed for the
+    seeded-violation tests)."""
+    out: List[Finding] = []
+    fa = class_fields(tree_a, cls)
+    fb = class_fields(tree_b, cls)
+    if fa is None:
+        return [Finding("config-parity", path_a, 0,
+                        f"mirrored class {cls} not found",
+                        detail=f"{cls}:missing-class")]
+    if fb is None:
+        return [Finding("config-parity", path_b, 0,
+                        f"mirrored class {cls} not found",
+                        detail=f"{cls}:missing-class")]
+    for name in sorted(set(fa) - set(fb) - extra_a):
+        out.append(Finding(
+            "config-parity", path_b, 0,
+            f"{cls}.{name} exists in {path_a} but not here — mirror "
+            "the field (same name, same default) or allow it "
+            "explicitly in tools/dslint/config_parity.py:PAIRS",
+            detail=f"{cls}.{name}:missing"))
+    for name in sorted(set(fb) - set(fa) - extra_b):
+        out.append(Finding(
+            "config-parity", path_a, 0,
+            f"{cls}.{name} exists in {path_b} but not here — mirror "
+            "the field (same name, same default) or allow it "
+            "explicitly in tools/dslint/config_parity.py:PAIRS",
+            detail=f"{cls}.{name}:missing"))
+    for name in sorted(set(fa) & set(fb)):
+        if fa[name] != fb[name]:
+            out.append(Finding(
+                "config-parity", path_b, 0,
+                f"{cls}.{name} default drift: {path_a} has "
+                f"{fa[name]!r}, {path_b} has {fb[name]!r}",
+                detail=f"{cls}.{name}:default"))
+    return out
+
+
+def check_to_v2_dict(tree: ast.AST, cls: str, path: str
+                     ) -> List[Finding]:
+    out: List[Finding] = []
+    fields = class_fields(tree, cls)
+    keys = to_v2_dict_keys(tree, cls)
+    if fields is None:
+        return out      # compare_pair already reported it
+    if keys is None:
+        return [Finding(
+            "config-parity", path, 0,
+            f"{cls}.to_v2_dict must return a dict literal the parity "
+            "pass can read", detail=f"{cls}:to_v2_dict-shape")]
+    for name in sorted(set(fields) - set(keys)):
+        out.append(Finding(
+            "config-parity", path, 0,
+            f"{cls}.{name} does not survive to_v2_dict — the serving "
+            "engine build would silently drop it",
+            detail=f"{cls}.{name}:to_v2_dict"))
+    for name in sorted(set(keys) - set(fields)):
+        out.append(Finding(
+            "config-parity", path, 0,
+            f"to_v2_dict emits {name!r} which is not a {cls} field",
+            detail=f"{cls}.{name}:to_v2_dict-extra"))
+    for name in sorted(set(keys) & set(fields)):
+        if keys[name] != f"self.{name}":
+            out.append(Finding(
+                "config-parity", path, 0,
+                f"to_v2_dict[{name!r}] is {keys[name]} (expected "
+                f"self.{name}) — a cross-wired key survives the "
+                "field-set check but ships the wrong value",
+                detail=f"{cls}.{name}:to_v2_dict-value"))
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    sfa = project.file(RUNTIME_CONFIG)
+    sfb = project.file(V2_CONFIG)
+    if sfa is None or sfb is None:
+        missing = RUNTIME_CONFIG if sfa is None else V2_CONFIG
+        return [Finding("config-parity", missing, 0,
+                        "config module missing from scan",
+                        detail="missing-module")]
+    out: List[Finding] = []
+    for cls, extra_a, extra_b in PAIRS:
+        out.extend(compare_pair(sfa.tree, sfb.tree, cls, extra_a,
+                                extra_b, sfa.rel, sfb.rel))
+    out.extend(check_to_v2_dict(sfa.tree, "ServingOptimizationConfig",
+                                sfa.rel))
+    return [f for f in out
+            if not _suppressed(project, f)]
+
+
+def _suppressed(project: Project, f: Finding) -> bool:
+    sf = project.file(f.path)
+    return sf is not None and f.line and sf.suppressed(f.rule, f.line)

@@ -1,0 +1,127 @@
+"""Pass 4 — disabled-path cost (rule ``disabled-path-guard``).
+
+The telemetry spine's standing promise, re-tested per module since
+PR 4: with telemetry off, every instrumentation entry point costs one
+attribute read (<5µs), never an allocation, f-string, or call.  This
+pass checks the SHAPE that promise requires: a function marked
+``# dslint: disabled-path`` must begin (docstring aside) with a single
+guard
+
+    if <attribute/flag expression>: return <trivial>
+
+whose test is built only from names, attributes, ``not``, ``and`` /
+``or``, and comparisons over those (``state.enabled``,
+``self.active``, ``not (state.enabled and self.enabled)``) — no
+calls, no f-strings, no subscripts — and whose early return is a bare
+``return`` or a pre-built constant/name/attribute (the shared no-op
+span/track objects).  Anything before or inside the guard that
+allocates or calls would be paid on EVERY disabled invocation.
+
+Coverage is required per module (REQUIRED_MODULES): each instrumented
+telemetry module must annotate at least one entry point, so the
+contract can't silently age out of a rewrite.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .core import Finding, Project, SourceFile, register_rules
+
+register_rules("disabled-path-guard")
+
+#: modules that must each carry >=1 annotated disabled-path function
+REQUIRED_MODULES: Tuple[str, ...] = (
+    "deepspeed_tpu/telemetry/tracer.py",
+    "deepspeed_tpu/telemetry/flight_recorder.py",
+    "deepspeed_tpu/telemetry/timeseries.py",
+    "deepspeed_tpu/telemetry/workload_trace.py",
+    "deepspeed_tpu/telemetry/watchdog.py",
+    "deepspeed_tpu/runtime/fault_injection.py",
+)
+
+
+def _attr_only(node: ast.AST) -> bool:
+    """True when the expression is names/attributes/constants combined
+    with not/and/or/comparisons — one-attribute-read territory."""
+    if isinstance(node, (ast.Name, ast.Constant)):
+        return True
+    if isinstance(node, ast.Attribute):
+        return _attr_only(node.value)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _attr_only(node.operand)
+    if isinstance(node, ast.BoolOp):
+        return all(_attr_only(v) for v in node.values)
+    if isinstance(node, ast.Compare):
+        return _attr_only(node.left) and all(
+            _attr_only(c) for c in node.comparators)
+    return False
+
+
+def _trivial_return(stmt: ast.stmt) -> bool:
+    if not isinstance(stmt, ast.Return):
+        return False
+    v = stmt.value
+    return v is None or isinstance(v, (ast.Constant, ast.Name)) or (
+        isinstance(v, ast.Attribute) and _attr_only(v))
+
+
+def check_guard(func: ast.AST) -> Optional[str]:
+    """None when the guard shape holds, else why it doesn't."""
+    body = list(func.body)
+    if body and isinstance(body[0], ast.Expr) and isinstance(
+            body[0].value, ast.Constant) and isinstance(
+            body[0].value.value, str):
+        body = body[1:]     # docstring
+    if not body:
+        return "empty body"
+    first = body[0]
+    if not isinstance(first, ast.If):
+        return (f"first statement is {type(first).__name__}, not the "
+                "disabled guard — work precedes the enabled check")
+    if not _attr_only(first.test):
+        return ("guard test is not a pure attribute/flag read "
+                f"(`{ast.unparse(first.test)}`) — a call or subscript "
+                "in the guard is paid on every disabled invocation")
+    if first.orelse:
+        return "guard has an else branch — not an early return"
+    if len(first.body) != 1 or not _trivial_return(first.body[0]):
+        return ("guard body must be exactly one trivial return "
+                "(bare / constant / pre-built no-op object)")
+    return None
+
+
+def run(project: Project,
+        required=REQUIRED_MODULES) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in required:
+        sf = project.file(rel)
+        if sf is None:
+            findings.append(Finding(
+                "disabled-path-guard", rel, 0,
+                "required disabled-path module missing from scan",
+                detail="missing-module"))
+            continue
+        if not any(sf.func_annotated(f, "disabled-path")
+                   for f in sf.functions()):
+            findings.append(Finding(
+                "disabled-path-guard", rel, 0,
+                "no '# dslint: disabled-path' annotated function in "
+                "this instrumented module — the <5µs contract has no "
+                "checked entry point here",
+                detail="no-annotation"))
+    for sf in project.files():
+        for func in sf.functions():
+            if not sf.func_annotated(func, "disabled-path"):
+                continue
+            why = check_guard(func)
+            if why is not None and not sf.suppressed(
+                    "disabled-path-guard", func.lineno):
+                findings.append(Finding(
+                    "disabled-path-guard", sf.rel, func.lineno,
+                    f"{func.name}() is documented <5µs disabled but "
+                    f"does not start with a single attribute-read "
+                    f"guard: {why}",
+                    detail=func.name))
+    return findings

@@ -1,0 +1,184 @@
+"""Pass 3 — lock discipline (rules ``telemetry-rlock``,
+``lock-held-io``).
+
+Two lock contracts, both paid for in review rounds (PR 5: a
+non-reentrant lock reachable from the SIGTERM postmortem handler would
+deadlock the dying process; PR 11: an HTTP fetch under the federation
+registry lock serialized every scrape behind the network):
+
+- ``telemetry-rlock``: the telemetry spine and the fault-injection
+  registry may only mint ``threading.RLock()`` — any code path can be
+  interrupted by the postmortem signal handler, which re-enters the
+  same locks to dump state.
+- ``lock-held-io``: no I/O (file ``open``, ``urlopen``, sockets,
+  ``requests``) or blocking call (``time.sleep``, ``subprocess``,
+  ``.join()`` on threads) may be *syntactically reachable* while a
+  telemetry lock is held.  Reachability is the ``with <...lock>:``
+  block body plus same-module helpers it calls (``self._foo()`` /
+  module-level ``foo()``), transitively — the exact shape of the PR 11
+  bug, where the fetch hid one call deep.
+
+Intentional holders (the workload ledger's append-under-lock design)
+carry ``# dslint: disable=lock-held-io -- <why>`` on the ``with``
+header, which covers the block.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, Project, SourceFile, register_rules,
+                   root_name as _root_name)
+
+register_rules("telemetry-rlock", "lock-held-io")
+
+#: modules bound by the lock contracts (glob on repo-relative path)
+LOCK_SCOPED_FILES = (
+    "deepspeed_tpu/telemetry/*.py",
+    "deepspeed_tpu/runtime/fault_injection.py",
+)
+
+#: blocking/I-O callables flagged under a held lock: (root, attr) with
+#: None as wildcard
+_BLOCKING_ATTRS = {
+    ("time", "sleep"), (None, "urlopen"), (None, "urlretrieve"),
+    ("socket", "socket"), ("socket", "create_connection"),
+    ("requests", "get"), ("requests", "post"), ("requests", "request"),
+    ("subprocess", "run"), ("subprocess", "Popen"),
+    ("subprocess", "call"), ("subprocess", "check_call"),
+    ("subprocess", "check_output"), ("os", "system"),
+}
+_BLOCKING_NAMES = {"open", "urlopen"}
+
+
+def _in_scope(rel: str) -> bool:
+    return any(fnmatch.fnmatch(rel, pat) for pat in LOCK_SCOPED_FILES)
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in _BLOCKING_NAMES:
+        return f"{func.id}()"
+    if isinstance(func, ast.Attribute):
+        root = _root_name(func.value)
+        for r, a in _BLOCKING_ATTRS:
+            if func.attr == a and (r is None or r == root):
+                return f"{root}.{func.attr}()" if root else \
+                    f".{func.attr}()"
+    return None
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    """``with self._lock:`` / ``with _lock:`` / any name or attribute
+    ending in 'lock'."""
+    if isinstance(node, ast.Name):
+        return node.id.endswith("lock")
+    if isinstance(node, ast.Attribute):
+        return node.attr.endswith("lock")
+    return False
+
+
+def _local_callables(sf: SourceFile) -> Dict[str, ast.AST]:
+    """name -> FunctionDef for every function in the module (methods
+    keyed by bare name: reachability is name-based, same-module)."""
+    out: Dict[str, ast.AST] = {}
+    for func in sf.functions():
+        out.setdefault(func.name, func)
+    return out
+
+
+def _called_local_names(node: ast.AST) -> Set[str]:
+    """Names of same-module callables invoked from ``node``:
+    ``self._foo(...)`` and bare ``foo(...)``."""
+    names: Set[str] = set()
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self":
+            names.add(f.attr)
+        elif isinstance(f, ast.Name):
+            names.add(f.id)
+    return names
+
+
+def _scan_held_block(sf: SourceFile, with_node: ast.With,
+                     local: Dict[str, ast.AST]) -> List[Finding]:
+    """BFS from the with-body through same-module callees, flagging
+    blocking calls anywhere reachable."""
+    out: List[Finding] = []
+    seen: Set[str] = set()
+    #: (node to scan, via-chain description)
+    queue: List[Tuple[ast.AST, str]] = [(stmt, "")
+                                        for stmt in with_node.body]
+    while queue:
+        node, via = queue.pop(0)
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                reason = _blocking_reason(n)
+                if reason is not None:
+                    line = n.lineno
+                    if sf.suppressed("lock-held-io", line) or \
+                            sf.suppressed("lock-held-io",
+                                          with_node.lineno):
+                        continue
+                    where = f" (via {via})" if via else ""
+                    out.append(Finding(
+                        "lock-held-io", sf.rel, line,
+                        f"{reason} reachable while the lock taken at "
+                        f"line {with_node.lineno} is held{where} — "
+                        "stage I/O outside the critical section, or "
+                        "suppress on the I/O line with a reason",
+                        detail=f"{_ctx(sf, n)}:{reason}"))
+        for name in sorted(_called_local_names(node)):
+            if name in seen or name not in local:
+                continue
+            seen.add(name)
+            queue.append((local[name],
+                          f"{via} -> {name}()" if via else f"{name}()"))
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in project.files():
+        if not _in_scope(sf.rel):
+            continue
+        local = _local_callables(sf)
+        for node in ast.walk(sf.tree):
+            # (a) RLock-only minting
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = f.attr if isinstance(f, ast.Attribute) else \
+                    getattr(f, "id", "")
+                root = _root_name(f.value) if isinstance(
+                    f, ast.Attribute) else None
+                if name == "Lock" and root in (None, "threading"):
+                    if not sf.suppressed("telemetry-rlock",
+                                         node.lineno):
+                        out.append(Finding(
+                            "telemetry-rlock", sf.rel, node.lineno,
+                            "threading.Lock() in a telemetry-scoped "
+                            "module — the postmortem SIGTERM handler "
+                            "re-enters these locks; use "
+                            "threading.RLock()",
+                            detail=f"Lock@{_ctx(sf, node)}"))
+            # (b) I/O reachable under a held lock
+            if isinstance(node, ast.With) and any(
+                    _is_lock_expr(item.context_expr)
+                    for item in node.items):
+                out.extend(_scan_held_block(sf, node, local))
+    return out
+
+
+def _ctx(sf: SourceFile, node: ast.AST) -> str:
+    """Enclosing function name for a stable baseline detail."""
+    best = "<module>"
+    for func in sf.functions():
+        if func.lineno <= node.lineno <= getattr(func, "end_lineno",
+                                                 func.lineno):
+            best = func.name
+    return best
