@@ -742,6 +742,29 @@ def bench_fastgen(jax):
                 sys.stderr.write(f"bench: fastgen pool leg failed: "
                                  f"{e}\n")
                 result["fastgen_pool_error"] = str(e)[:300]
+        if os.environ.get("BENCH_TIER", "0") != "0":
+            # tiered-KV leg (ISSUE 16): (1) int8 pages vs fp at an
+            # EQUAL device byte budget on the replayed trace —
+            # resident-sequence capacity from the allocator's own
+            # bytes_per_page accounting plus measured TTFT p99
+            # before/after; (2) a device-starved engine backed by the
+            # host/disk prefix tier, warm-wave tier hit rates mined
+            # from the replay's own workload ledger, promote-batch
+            # p50; (3) cross-replica page fetch TTFT vs
+            # recompute-prefill under an identical backlog shape.
+            # check_bench gates: resident ratio >= 1.7x, TTFT p99 not
+            # up >15%, tier actually warming, fetch beating recompute,
+            # zero on-path compiles.  Off by default (builds five
+            # engines); own try.
+            try:
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                from tools.replay_trace import run_tier_bench
+                result.update(run_tier_bench())
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: fastgen tier leg failed: "
+                                 f"{e}\n")
+                result["fastgen_tier_error"] = str(e)[:300]
         if os.environ.get("BENCH_COLDSTART", "0") != "0":
             # cold-start leg (ISSUE 14): three-way restore-to-first-
             # token comparison across REAL process boundaries — cold

@@ -82,7 +82,8 @@ def compile_config_digest(model_cfg: Any, kv_config: Any,
         "model": repr(model_cfg),
         "kv": [int(kv_config.num_layers), int(kv_config.kv_heads),
                int(kv_config.head_dim), int(kv_config.page_size),
-               str(kv_config.dtype)],
+               str(kv_config.dtype),
+               str(getattr(kv_config, "quantization", "none"))],
         "keyed_sampling": bool(keyed_sampling),
         "lattice": str(lattice_digest),
         "jax": jax.__version__,

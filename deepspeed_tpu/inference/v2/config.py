@@ -124,6 +124,24 @@ class ServingOptimizationConfig:
     #: smaller precompiled program set, tokenwise identical output.
     #: A config-digest mismatch refuses at engine build (LatticeError)
     lattice: str = ""
+    # -- tiered KV at fleet scale (ISSUE 16) ----------------------------
+    #: KV page storage format: "none" (fp pages at the cache dtype) or
+    #: "int8" (block-scaled codes + fp32 scale per head_dim block) —
+    #: ~2x resident sequences per chip at a bounded greedy-agreement
+    #: cost (see DESIGN.md "Tiered KV").  Engine-build-time: it shapes
+    #: the cache arrays and every compiled step program
+    kv_quantization: str = "none"
+    #: host DRAM prefix tier: parked pages that eviction would free are
+    #: demoted into a bounded host ring (this many pages; 0 = tier off)
+    #: keyed by the same chained prefix digests, and promoted back on a
+    #: prefix match — a flushed prefix is a warm hit, not a recompute
+    kv_tier_host_pages: int = 0
+    #: disk prefix tier below the host ring (pages; 0 = off): host-ring
+    #: overflow spills to ``kv_tier_dir`` via the in-tree AIO path
+    kv_tier_disk_pages: int = 0
+    #: directory for the disk tier's page files ("" = a per-process
+    #: temp dir, deleted with the store)
+    kv_tier_dir: str = ""
 
 
 @dataclasses.dataclass

@@ -153,6 +153,7 @@ class InferenceEngineV2:
             # idempotent per format and refuses a format change)
             model.quantize_weights(self._config.quantization.fmt)
         kv_user = self._config.kv_cache
+        prev_quant = model.kv_config.quantization
         if not model.kv_config_explicit:
             # user config wins over the model's default cache geometry;
             # num_pages=None is sized from free-memory fraction (reference
@@ -162,7 +163,10 @@ class InferenceEngineV2:
                 kv_heads=model.kv_config.kv_heads,
                 head_dim=model.kv_config.head_dim,
                 page_size=kv_user.page_size,
-                num_pages=kv_user.num_pages or 1, dtype=kv_user.dtype)
+                num_pages=kv_user.num_pages or 1, dtype=kv_user.dtype,
+                quantization=(
+                    getattr(self._config.serving, "kv_quantization",
+                            "none") or "none"))
             if kv_user.num_pages is None:
                 budget = self._free_device_memory()
                 if budget is not None:
@@ -176,6 +180,19 @@ class InferenceEngineV2:
             model.kv_config = kv_cfg
         else:
             kv_cfg = model.kv_config
+            # an explicit model kv_config still honors the serving
+            # knob — quantization is a cache encoding, not geometry
+            quant = (getattr(self._config.serving, "kv_quantization",
+                             "none") or "none")
+            if quant != kv_cfg.quantization:
+                kv_cfg = dataclasses.replace(kv_cfg, quantization=quant)
+                model.kv_config = kv_cfg
+        if kv_cfg.quantization != prev_quant:
+            # the kv leaf's pytree TYPE changed (ndarray <-> KVPages):
+            # programs traced for the old encoding cannot be called
+            # with the new one — drop them, like quantize_weights does
+            model._step_cache.clear()
+            model._program_costs.clear()
         # keyed sampling (ISSUE 13) changes the traced signatures of
         # every sampling-capable step kind, so it is fixed at engine
         # build, before any precompile/lattice work
@@ -233,11 +250,15 @@ class InferenceEngineV2:
                                 if self._lattice is not None else ""))
             self._compile_cache_dir = enable_compile_cache(cache_dir,
                                                            digest)
+        sv = self._config.serving
         self._state = StateManager(
             kv_cfg,
             max_tracked_sequences=self._config.state_manager.max_tracked_sequences,
             kv_sharding=model.kv_sharding(),
-            prefix_caching=self._config.serving.prefix_caching)
+            prefix_caching=self._config.serving.prefix_caching,
+            tier_host_pages=int(getattr(sv, "kv_tier_host_pages", 0) or 0),
+            tier_disk_pages=int(getattr(sv, "kv_tier_disk_pages", 0) or 0),
+            tier_dir=getattr(sv, "kv_tier_dir", None))
         self._config.telemetry.apply()
         self._config.fault_injection.apply()
         self._bind_kv_gauges()
@@ -291,6 +312,20 @@ class InferenceEngineV2:
         tm.KV_LIVE_PAGES.bind(read("live_pages"))
         tm.KV_PARKED_PAGES.bind(read("parked_pages"))
         tm.KV_TOTAL_PAGES.bind(read("total_pages"))
+        # tier occupancy gauges (ISSUE 16): same weakref discipline,
+        # pointing at the manager's tier store (absent => 0)
+        tref = weakref.ref(self._state)
+
+        def tier_read(attr):
+            def _read(r=tref, a=attr):
+                st = r()
+                tiers = getattr(st, "tiers", None) if st is not None \
+                    else None
+                return getattr(tiers, a) if tiers is not None else 0
+            return _read
+
+        tm.KV_TIER_HOST_PAGES.bind(tier_read("host_pages"))
+        tm.KV_TIER_DISK_PAGES.bind(tier_read("disk_pages"))
 
     def precompile(self, max_prompt: int, max_concurrency: int = 0,
                    max_new_tokens: int = 256,
@@ -939,6 +974,31 @@ class InferenceEngineV2:
         """Drop every cache entry and return parked pages to the pool
         (bench/test cold-start control)."""
         self._state.reset_prefix_cache()
+
+    def tier_hits(self, uid: int) -> Optional[dict]:
+        """Warm-prefix provenance for a tracked sequence (ISSUE 16):
+        tokens attached at admission per tier
+        (device/host/disk/remote), or None before match_prefix ran —
+        the workload ledger's per-request tier-hit fields."""
+        sd = self._state.get_sequence(uid)
+        return None if sd is None else sd.tier_hits
+
+    # -- cross-replica page fetch (ISSUE 16 tentpole c) ---------------------
+    def export_prefix(self, digests_hex: List[str],
+                      max_pages: int = 64):
+        """Export the KV contents for the leading run of a request's
+        cumulative digest chain that this engine's prefix cache holds —
+        the page-fetch half a pool streams to an affinity-missed
+        placement.  Returns ``(meta, arrays)`` or None when cold."""
+        return self._state.export_prefix(digests_hex,
+                                         max_pages=max_pages)
+
+    def import_prefix(self, meta: dict, arrays: dict) -> dict:
+        """Merge a peer's exported prefix pages into this engine's
+        cache as parked indexed pages (the fetched request's admission
+        then match_prefix-hits them locally).  Raises the retryable
+        :class:`~.ragged.KVAllocationError` when the pool lacks room."""
+        return self._state.import_prefix(meta, arrays)
 
     def flush(self, uid: int) -> None:
         self._state.flush_sequence(uid)

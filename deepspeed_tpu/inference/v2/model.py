@@ -660,7 +660,9 @@ class RaggedInferenceModel:
             for i in range(cfg.num_layers):
                 x, kv_i = body(x, params["layers"][f"layer_{i}"], kv[i])
                 kv_layers.append(kv_i)
-            kv = jnp.stack(kv_layers)
+            # tree-aware stack: kv may be a KVPages (payload, scale)
+            # pytree (ISSUE 16 quantized pages) as well as a plain array
+            kv = jax.tree.map(lambda *xs: jnp.stack(xs), *kv_layers)
 
         return self._norm(params["final_norm"], x), kv
 

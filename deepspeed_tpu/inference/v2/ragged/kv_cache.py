@@ -10,16 +10,26 @@ so the per-layer slice falls out of the layer ``lax.scan`` naturally and
 the whole cache is a single donated buffer across forwards (XLA updates
 it in place; no allocator traffic on device).  Page 0 is the null page
 (see blocked_allocator.py) — real pages are 1..num_pages.
+
+Quantized pages (ISSUE 16): with ``quantization="int8"`` the device
+store is an :class:`~deepspeed_tpu.ops.paged_attention.KVPages` pair —
+int8 codes at the layout above plus a per-(token, kv-head) fp32 scale
+sidecar ``[L, num_pages+1, page_size, 2, K]``.  Host-side page blobs
+become :class:`PageBlob` (payload + scales travel together through
+offload/snapshot/handoff), and ``bytes_per_page`` accounts the true
+quantized footprint so a byte budget buys ~2x the pages.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ....ops.paged_attention import KV_QUANT_FORMATS, KVPages
 from .blocked_allocator import BlockedAllocator
 
 
@@ -31,12 +41,33 @@ class KVCacheConfig:
     page_size: int = 64
     num_pages: int = 1024
     dtype: Any = jnp.bfloat16
+    #: "none" (fp pages at ``dtype``) or "int8" (block-scaled codes +
+    #: fp32 scale per head_dim block)
+    quantization: str = "none"
+
+    def __post_init__(self):
+        if self.quantization not in KV_QUANT_FORMATS:
+            raise ValueError(
+                f"unknown kv quantization {self.quantization!r} "
+                f"(supported: {KV_QUANT_FORMATS})")
+
+    @property
+    def quantized(self) -> bool:
+        return self.quantization != "none"
 
     @property
     def bytes_per_page(self) -> int:
+        elems = (self.num_layers * self.page_size * 2 * self.kv_heads
+                 * self.head_dim)
+        if self.quantized:
+            # 1 byte per code + one fp32 scale per head_dim block: the
+            # honest footprint, so pages_for_memory converts a byte
+            # budget into ~2x resident pages (the ISSUE 16 lever)
+            scales = (self.num_layers * self.page_size * 2
+                      * self.kv_heads)
+            return elems + scales * 4
         itemsize = jnp.dtype(self.dtype).itemsize
-        return (self.num_layers * self.page_size * 2 * self.kv_heads
-                * self.head_dim * itemsize)
+        return elems * itemsize
 
     def total_bytes(self) -> int:
         return self.bytes_per_page * (self.num_pages + 1)
@@ -48,12 +79,61 @@ def pages_for_memory(cfg: KVCacheConfig, budget_bytes: int) -> int:
     return max(1, budget_bytes // cfg.bytes_per_page)
 
 
-import functools
+class PageBlob:
+    """Host-side blob of quantized pages: int8 payload
+    ``[L, n, page, 2, K, D]`` + fp32 scales ``[L, n, page, 2, K]``
+    traveling as one unit through offload / snapshot / handoff codecs.
+    Mimics the ndarray surface those codecs touch (``shape`` and
+    ``nbytes`` of the payload, axis-1 column selection), so the fp path
+    keeps returning plain ndarrays unchanged."""
+
+    __slots__ = ("payload", "scale")
+
+    def __init__(self, payload, scale):
+        import numpy as np
+        self.payload = np.asarray(payload)
+        self.scale = np.asarray(scale)
+
+    @property
+    def shape(self):
+        return self.payload.shape
+
+    @property
+    def nbytes(self) -> int:
+        return self.payload.nbytes + self.scale.nbytes
+
+    def select(self, cols) -> "PageBlob":
+        """Column selection along the page axis (the selective-import
+        codec's ``blob[:, cols]``)."""
+        return PageBlob(self.payload[:, cols], self.scale[:, cols])
+
+    def __getitem__(self, idx):
+        return PageBlob(self.payload[idx], self.scale[idx])
+
+
+def blob_columns(blob, cols):
+    """``blob[:, cols]`` for plain ndarrays and :class:`PageBlob`."""
+    if isinstance(blob, PageBlob):
+        return blob.select(cols)
+    return blob[:, cols]
+
+
+def concat_blobs(blobs):
+    """Concatenate page blobs along the page axis (tier promotion
+    reassembles a digest chain's single-page blobs into one scatter)."""
+    import numpy as np
+    if isinstance(blobs[0], PageBlob):
+        return PageBlob(
+            np.concatenate([b.payload for b in blobs], axis=1),
+            np.concatenate([b.scale for b in blobs], axis=1))
+    return np.concatenate([np.asarray(b) for b in blobs], axis=1)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_pages(data, idx, blob):
-    return data.at[:, idx].set(blob)
+    # data/blob may be KVPages pytrees: scatter each leaf at the same
+    # page columns (payload and scales stay paired by construction)
+    return jax.tree.map(lambda d, b: d.at[:, idx].set(b), data, blob)
 
 
 class BlockedKVCache:
@@ -65,11 +145,35 @@ class BlockedKVCache:
         self.allocator = BlockedAllocator(cfg.num_pages)
         shape = (cfg.num_layers, cfg.num_pages + 1, cfg.page_size, 2,
                  cfg.kv_heads, cfg.head_dim)
-        if sharding is not None:
+        if cfg.quantized:
+            data = KVPages(jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape[:-1], jnp.float32))
+            if sharding is not None:
+                data = KVPages(
+                    jax.device_put(data.payload, sharding),
+                    jax.device_put(data.scale,
+                                   self._scale_sharding(sharding)))
+            self.data = data
+        elif sharding is not None:
             self.data = jax.device_put(
                 jnp.zeros(shape, cfg.dtype), sharding)
         else:
             self.data = jnp.zeros(shape, cfg.dtype)
+
+    @staticmethod
+    def _scale_sharding(sharding):
+        """The scale sidecar drops the head_dim axis, so its sharding is
+        the payload's minus the last entry (kv heads stay sharded
+        identically); non-named shardings fall back to replication."""
+        try:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            if isinstance(sharding, NamedSharding):
+                return NamedSharding(sharding.mesh,
+                                     P(*tuple(sharding.spec)[:-1]))
+        except Exception:
+            pass
+        return None
 
     @property
     def free_pages(self) -> int:
@@ -104,21 +208,26 @@ class BlockedKVCache:
         return b
 
     # -- sequence offload/restore (reference kv_cache.py:166-184) --------
-    def read_pages(self, pages) -> "np.ndarray":
+    def read_pages(self, pages):
         """Copy the given pages to host WITHOUT freeing them — the
         page-transfer export half shared by serving snapshots (ISSUE 8)
         and the disagg handoff (ISSUE 13).  Returns the host blob
-        [L, n, page, 2, K, D]; ``restore_pages`` is the matching
-        import."""
+        [L, n, page, 2, K, D] (a :class:`PageBlob` when quantized);
+        ``restore_pages`` is the matching import."""
         import numpy as np
         pages = list(pages)
         n = len(pages)
         idx = np.zeros(self._transfer_bucket(n), np.int32)
         idx[:n] = pages
-        blob = np.asarray(self.data[:, jnp.asarray(idx)])
+        jidx = jnp.asarray(idx)
+        if self.cfg.quantized:
+            return PageBlob(
+                np.asarray(self.data.payload[:, jidx])[:, :n],
+                np.asarray(self.data.scale[:, jidx])[:, :n])
+        blob = np.asarray(self.data[:, jidx])
         return blob[:, :n]
 
-    def offload_pages(self, pages) -> "np.ndarray":
+    def offload_pages(self, pages):
         """Copy the given pages to HOST memory and free them on device —
         the preemption half of the reference's offload/restore hooks
         (evict a long sequence's KV under pressure, bring it back
@@ -141,10 +250,28 @@ class BlockedKVCache:
         b = self._transfer_bucket(n)
         idx = np.zeros(b, np.int32)
         idx[:n] = pages
-        if b != n:
-            pad = np.zeros(blob.shape[:1] + (b - n,) + blob.shape[2:],
-                           dtype=np.asarray(blob).dtype)
-            blob = np.concatenate([np.asarray(blob), pad], axis=1)
-        self.data = _scatter_pages(self.data, jnp.asarray(idx),
-                                   jnp.asarray(blob, self.cfg.dtype))
+
+        def pad_cols(arr, dtype):
+            arr = np.asarray(arr)
+            if b == n:
+                return jnp.asarray(arr, dtype)
+            pad = np.zeros(arr.shape[:1] + (b - n,) + arr.shape[2:],
+                           dtype=arr.dtype)
+            return jnp.asarray(np.concatenate([arr, pad], axis=1), dtype)
+
+        if self.cfg.quantized:
+            if not isinstance(blob, PageBlob):
+                raise TypeError(
+                    "quantized cache restore requires a PageBlob "
+                    "(payload + scales); got a bare array — the source "
+                    "pool's quantization mode must match")
+            dev_blob = KVPages(pad_cols(blob.payload, jnp.int8),
+                               pad_cols(blob.scale, jnp.float32))
+        else:
+            if isinstance(blob, PageBlob):
+                raise TypeError(
+                    "fp cache restore got a quantized PageBlob — the "
+                    "source pool's quantization mode must match")
+            dev_blob = pad_cols(blob, self.cfg.dtype)
+        self.data = _scatter_pages(self.data, jnp.asarray(idx), dev_blob)
         return np.asarray(pages)

@@ -14,17 +14,21 @@ accounting and steady-state capacity are unchanged.
 
 from __future__ import annotations
 
+import time
 from collections import Counter
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 import numpy as np
 
 from ....runtime.fault_injection import get_fault_injector
+from ....telemetry import metrics as tm
 from ....telemetry import trace_span
 from ....telemetry.flight_recorder import get_flight_recorder
 from ....utils.comms_logging import serving_counters
 from .blocked_allocator import KVAllocationError, NULL_PAGE
-from .kv_cache import BlockedKVCache, KVCacheConfig
+from .kv_cache import (BlockedKVCache, KVCacheConfig, PageBlob,
+                       blob_columns, concat_blobs)
+from .kv_tiers import TieredPageStore
 from .prefix_cache import PrefixCache
 from .sequence import SequenceDescriptor
 
@@ -33,12 +37,26 @@ class StateManager:
     def __init__(self, kv_config: KVCacheConfig,
                  max_tracked_sequences: int = 2048,
                  kv_sharding=None,
-                 prefix_caching: bool = True):
+                 prefix_caching: bool = True,
+                 tier_host_pages: int = 0,
+                 tier_disk_pages: int = 0,
+                 tier_dir: Optional[str] = None):
         self.kv_config = kv_config
         self.max_tracked_sequences = max_tracked_sequences
         self.kv_cache = BlockedKVCache(kv_config, sharding=kv_sharding)
         self.prefix_cache: Optional[PrefixCache] = (
             PrefixCache(kv_config.page_size) if prefix_caching else None)
+        # host/disk prefix tier (ISSUE 16): only meaningful under the
+        # device prefix index — the tier is keyed by its chain digests
+        self.tiers: Optional[TieredPageStore] = None
+        if tier_host_pages > 0 and self.prefix_cache is not None:
+            self.tiers = TieredPageStore(tier_host_pages,
+                                         disk_pages=tier_disk_pages,
+                                         disk_dir=tier_dir or None)
+        #: chain digests whose device pages were imported from a peer
+        #: replica (cross-replica page fetch) — attributes their FIRST
+        #: local match to the "remote" tier in the workload ledger
+        self._remote_digests: Set[bytes] = set()
         self._seqs: Dict[int, SequenceDescriptor] = {}
         # offloaded-host-blob accounting (ISSUE 8): preempted sequences
         # hold KV in host blobs that device-page accounting can't see —
@@ -46,6 +64,11 @@ class StateManager:
         # releases its blob (check_invariants audits the counters)
         self._offload_blobs = 0
         self._offload_bytes = 0
+
+    def close(self) -> None:
+        """Release tier resources (AIO handle, owned disk dir)."""
+        if self.tiers is not None:
+            self.tiers.close()
 
     # -- sequence tracking --------------------------------------------------
     @property
@@ -117,12 +140,35 @@ class StateManager:
         if deficit <= 0 or self.prefix_cache is None:
             return
         with trace_span("kv.evict"):
-            evicted = self.prefix_cache.evict(deficit, alloc.is_parked)
-            if evicted:
-                alloc.reclaim(evicted)
-                serving_counters.record_prefix_evicted(len(evicted))
-                get_flight_recorder().record("kv.evict",
-                                             pages=len(evicted))
+            entries = self.prefix_cache.evict_entries(deficit,
+                                                      alloc.is_parked)
+            if not entries:
+                return
+            if self.tiers is not None:
+                # demote BEFORE reclaim: page contents are read while
+                # the pages are still allocated.  ensure_free only runs
+                # from admission paths (never the scheduler's dispatch
+                # hot loop — the dslint hot-path pass is the guard), so
+                # the d2h gather + tier write stay off the hot path
+                self._demote(entries)
+            evicted = [p for _, p in entries]
+            alloc.reclaim(evicted)
+            serving_counters.record_prefix_evicted(len(evicted))
+            get_flight_recorder().record("kv.evict", pages=len(evicted))
+
+    def _demote(self, entries: List[tuple]) -> None:
+        """Store evicted parked pages' contents in the host/disk tier
+        under their cumulative chain digests.  A refused put (tier I/O
+        error, duplicate digest) just loses that page's warmth — the
+        eviction itself proceeds regardless."""
+        with trace_span("kv.demote"):
+            blob = self.kv_cache.read_pages([p for _, p in entries])
+            stored = 0
+            for i, (digest, _page) in enumerate(entries):
+                if self.tiers.put(digest, blob_columns(blob, [i])):
+                    stored += 1
+            if stored:
+                get_flight_recorder().record("kv.demote", pages=stored)
 
     # -- prefix cache -------------------------------------------------------
     def match_prefix(self, sd: SequenceDescriptor,
@@ -143,14 +189,95 @@ class StateManager:
             return 0
         with trace_span("kv.match_prefix"):
             pages, digest = self.prefix_cache.match(prompt, max_pages)
+            hits = {"device": 0, "host": 0, "disk": 0, "remote": 0}
+            if pages:
+                # attach the device hits FIRST: live references make
+                # the matched pages un-evictable while the promotion
+                # below runs ensure_free for its landing pages
+                self.kv_cache.allocator.add_ref(pages)
+                self._attribute_device_hits(prompt, len(pages), hits)
+            promoted: List[int] = []
+            if self.tiers is not None and len(pages) < max_pages:
+                promoted, digest = self._promote_chain(
+                    prompt, len(pages), digest, max_pages, hits)
+            pages = [int(p) for p in pages] + promoted
             if not pages:
                 return 0
-            self.kv_cache.allocator.add_ref(pages)
-            sd.pages = [int(p) for p in pages]
+            sd.pages = pages
             sd.seen_tokens = len(pages) * page
             sd.indexed_pages = len(pages)
             sd.last_digest = digest
+            sd.tier_hits = hits
             return sd.seen_tokens
+
+    def _attribute_device_hits(self, prompt: np.ndarray, n_pages: int,
+                               hits: dict) -> None:
+        """Split a device prefix match into device-born vs remote-born
+        tokens: pages imported by a cross-replica fetch count as
+        "remote" on their FIRST match (then the digest demotes to plain
+        device provenance)."""
+        page = self.kv_config.page_size
+        if not self._remote_digests:
+            hits["device"] = n_pages * page
+            return
+        d = b""
+        for i in range(n_pages):
+            d = self.prefix_cache.chain(d, prompt[i * page:(i + 1) * page])
+            if d in self._remote_digests:
+                self._remote_digests.discard(d)
+                hits["remote"] += page
+            else:
+                hits["device"] += page
+
+    def _promote_chain(self, prompt: np.ndarray, n_matched: int,
+                       digest: bytes, max_pages: int,
+                       hits: dict) -> tuple:
+        """Extend a device prefix match past its first miss by walking
+        the SAME digest chain into the host/disk tier (ISSUE 16).
+        Promoted blobs are scattered onto fresh device pages and
+        re-indexed, so the next same-prefix request hits on device.
+        Returns ``(promoted page ids, new chain cursor)``; any tier
+        miss/failure just stops the walk — a shorter warm prefix, never
+        an admission error."""
+        page = self.kv_config.page_size
+        chain: List[bytes] = []
+        d = digest
+        for i in range(n_matched, max_pages):
+            d = self.prefix_cache.chain(d, prompt[i * page:(i + 1) * page])
+            if self.tiers.contains(d) is None:
+                break
+            chain.append(d)
+        if not chain:
+            return [], digest
+        t0 = time.perf_counter()
+        with trace_span("kv.promote"):
+            blobs, hit_tiers = self.tiers.take_many(chain)
+            if not blobs:
+                return [], digest
+            try:
+                self.ensure_free(len(blobs))
+                new_pages = self.kv_cache.restore_pages(
+                    concat_blobs(blobs))
+            except KVAllocationError:
+                # pool full of live pages: the promotion loses (the
+                # blobs already left the tier) — a clean miss, never an
+                # error on the admission path
+                self.tiers.landed(len(blobs))
+                return [], digest
+            self.tiers.landed(len(blobs))
+            # refcount 1 from restore_pages = this sequence's reference
+            # (device-matched pages got theirs from add_ref above)
+            for cd, p in zip(chain, new_pages):
+                self.prefix_cache.insert(cd, int(p))
+            for t in hit_tiers:
+                hits[t] += page
+            tm.KV_TIER_PROMOTE_MS.observe(
+                (time.perf_counter() - t0) * 1000.0)
+            get_flight_recorder().record(
+                "kv.promote", pages=len(blobs),
+                host=hit_tiers.count("host"),
+                disk=hit_tiers.count("disk"))
+        return [int(p) for p in new_pages], chain[len(blobs) - 1]
 
     def index_prefix(self, sd: SequenceDescriptor) -> None:
         """Index newly-committed FULL prompt pages (called after each
@@ -170,7 +297,12 @@ class StateManager:
                     sd.prompt_tokens[i * page:(i + 1) * page])
                 p = sd.pages[i] if i < len(sd.pages) else NULL_PAGE
                 if p != NULL_PAGE:  # window-evicted slots can't be indexed
-                    self.prefix_cache.insert(digest, int(p))
+                    if self.prefix_cache.insert(digest, int(p)) \
+                            and self.tiers is not None:
+                        # a re-prefilled prefix supersedes any demoted
+                        # copy: a digest is never device-indexed and
+                        # tier-resident at once
+                        self.tiers.discard(digest)
                 sd.last_digest = digest
                 sd.indexed_pages = i + 1
 
@@ -193,6 +325,8 @@ class StateManager:
                   if alloc.is_parked(p)]
         if parked:
             alloc.reclaim(parked)
+        if self.tiers is not None:
+            self.tiers.clear()      # cold start means cold everywhere
 
     # -- lifecycle ----------------------------------------------------------
     def offloadable_slots(self, sd: SequenceDescriptor) -> List[int]:
@@ -352,7 +486,11 @@ class StateManager:
                               if p in seen]
         arrays: Dict[str, np.ndarray] = {}
         if page_order:
-            arrays["page_blob"] = self.kv_cache.read_pages(page_order)
+            # quantized caches export as (payload, scale) array pairs —
+            # snapshot/handoff codecs carry named numpy arrays only, so
+            # a PageBlob travels split and is reassembled on import
+            self._pack_blob(arrays, "page_blob",
+                            self.kv_cache.read_pages(page_order))
         seqs = []
         for uid, sd in export_seqs.items():
             m = {"uid": int(uid), "seen_tokens": int(sd.seen_tokens),
@@ -366,13 +504,10 @@ class StateManager:
                 arrays[f"prompt_{uid}"] = np.asarray(sd.prompt_tokens,
                                                      np.int32)
             if sd.host_blob is not None:
-                arrays[f"hostblob_{uid}"] = np.asarray(sd.host_blob)
+                self._pack_blob(arrays, f"hostblob_{uid}", sd.host_blob)
             seqs.append(m)
-        kv = self.kv_config
         meta = {
-            "kv": {"num_layers": kv.num_layers, "kv_heads": kv.kv_heads,
-                   "head_dim": kv.head_dim, "page_size": kv.page_size,
-                   "dtype": np.dtype(kv.dtype).name},
+            "kv": self._kv_meta(),
             "prefix_caching": self.prefix_cache is not None,
             "page_ids": page_order,
             "sequences": seqs,
@@ -382,15 +517,45 @@ class StateManager:
             meta["selective"] = True
         return meta, arrays
 
+    def _kv_meta(self) -> dict:
+        cfg = self.kv_config
+        return {"num_layers": cfg.num_layers, "kv_heads": cfg.kv_heads,
+                "head_dim": cfg.head_dim, "page_size": cfg.page_size,
+                "dtype": np.dtype(cfg.dtype).name,
+                "quantization": cfg.quantization}
+
     def _check_kv_meta(self, meta: dict) -> None:
         from ..snapshot import SnapshotError
-        kv, cfg = meta["kv"], self.kv_config
-        ours = {"num_layers": cfg.num_layers, "kv_heads": cfg.kv_heads,
-                "head_dim": cfg.head_dim, "page_size": cfg.page_size,
-                "dtype": np.dtype(cfg.dtype).name}
+        # pre-quantization bundles carry no "quantization" key — they
+        # are fp by construction, so normalize instead of refusing
+        kv = dict(meta["kv"])
+        kv.setdefault("quantization", "none")
+        ours = self._kv_meta()
         if kv != ours:
             raise SnapshotError(
                 f"KV geometry mismatch: bundle {kv} vs engine {ours}")
+
+    @staticmethod
+    def _pack_blob(arrays: Dict[str, np.ndarray], key: str,
+                   blob) -> None:
+        """Store a page blob under ``key`` as named numpy arrays: a
+        quantized :class:`PageBlob` splits into payload + ``_scale``."""
+        if isinstance(blob, PageBlob):
+            arrays[key] = blob.payload
+            arrays[key + "_scale"] = blob.scale
+        else:
+            arrays[key] = np.asarray(blob)
+
+    @staticmethod
+    def _unpack_blob(arrays: Dict[str, np.ndarray], key: str):
+        """Inverse of ``_pack_blob``; None when ``key`` is absent."""
+        payload = arrays.get(key)
+        if payload is None:
+            return None
+        scale = arrays.get(key + "_scale")
+        if scale is not None:
+            return PageBlob(payload, scale)
+        return payload
 
     def import_state(self, meta: dict, arrays: Dict[str, np.ndarray]
                      ) -> Optional[dict]:
@@ -433,7 +598,7 @@ class StateManager:
                 f"{alloc.free_pages} free")
         mapping = {NULL_PAGE: NULL_PAGE}
         if old_ids:
-            blob = arrays.get("page_blob")
+            blob = self._unpack_blob(arrays, "page_blob")
             if blob is None or blob.shape[1] != len(old_ids):
                 raise SnapshotError(
                     "page blob missing or inconsistent with page_ids")
@@ -468,7 +633,8 @@ class StateManager:
                 sd.prompt_tokens = np.asarray(arrays[f"prompt_{uid}"],
                                               np.int32)
             if m["has_blob"]:
-                sd.host_blob = arrays[f"hostblob_{uid}"]
+                sd.host_blob = self._unpack_blob(arrays,
+                                                 f"hostblob_{uid}")
                 self._offload_blobs += 1
                 self._offload_bytes += sd.host_blob.nbytes
             self._seqs[uid] = sd
@@ -510,7 +676,7 @@ class StateManager:
                 f"(limit {self.max_tracked_sequences}) — retry after "
                 "the pool drains")
         old_ids = [int(p) for p in meta["page_ids"]]
-        blob = arrays.get("page_blob")
+        blob = self._unpack_blob(arrays, "page_blob")
         if old_ids and (blob is None or blob.shape[1] != len(old_ids)):
             raise SnapshotError(
                 "page blob missing or inconsistent with page_ids")
@@ -564,8 +730,7 @@ class StateManager:
         if stream:
             self.ensure_free(len(stream))
             col = {p: i for i, p in enumerate(old_ids)}
-            sub = np.ascontiguousarray(
-                blob[:, [col[p] for p in stream]])
+            sub = blob_columns(blob, [col[p] for p in stream])
             new = self.kv_cache.restore_pages(sub)   # refcount 1 each
             for old, newp in zip(stream, new):
                 mapping[old] = int(newp)
@@ -592,7 +757,8 @@ class StateManager:
                 sd.prompt_tokens = np.asarray(arrays[f"prompt_{uid}"],
                                               np.int32)
             if m["has_blob"]:
-                sd.host_blob = arrays[f"hostblob_{uid}"]
+                sd.host_blob = self._unpack_blob(arrays,
+                                                 f"hostblob_{uid}")
                 self._offload_blobs += 1
                 self._offload_bytes += sd.host_blob.nbytes
             self._seqs[uid] = sd
@@ -600,10 +766,96 @@ class StateManager:
             for d_hex, p in meta["prefix"]:
                 newp = mapping.get(int(p))
                 if newp is not None:
-                    self.prefix_cache.insert(bytes.fromhex(d_hex),
-                                             int(newp))
+                    d = bytes.fromhex(d_hex)
+                    if self.prefix_cache.insert(d, int(newp)) \
+                            and self.tiers is not None:
+                        self.tiers.discard(d)
         return {"pages_streamed": len(stream),
                 "pages_shared": len(dedup)}
+
+    # -- cross-replica page fetch (ISSUE 16 tentpole c) ---------------------
+    # A pool-level sibling of the disagg handoff: when the router's
+    # least-backlog placement loses the affinity match, the chosen
+    # replica imports the matched committed prefix pages from the
+    # replica that holds them instead of recomputing the prefill.  Only
+    # (digest, page contents) cross — no sequences, no block tables —
+    # and the imported pages land PARKED + indexed, so the request's
+    # normal admission immediately match_prefix-hits them.
+
+    def export_prefix(self, digests_hex: List[str],
+                      max_pages: int = 64) -> Optional[tuple]:
+        """Export the KV contents for the leading run of ``digests_hex``
+        (a request's cumulative chain, root first) that this manager's
+        prefix index holds.  Returns ``(meta, arrays)`` riding the same
+        named-numpy-array convention as the handoff codec (quantized
+        payloads travel quantized), or None on a cold index."""
+        if self.prefix_cache is None or not digests_hex:
+            return None
+        alloc = self.kv_cache.allocator
+        chain: List[tuple] = []
+        for h in digests_hex[:max_pages]:
+            try:
+                d = bytes.fromhex(h)
+            except ValueError:
+                break
+            p = self.prefix_cache.lookup(d)
+            if p is None or not alloc.is_allocated(int(p)):
+                break       # the chain is only usable contiguously
+            chain.append((d, int(p)))
+        if not chain:
+            return None
+        with trace_span("kv.export_prefix"):
+            blob = self.kv_cache.read_pages([p for _, p in chain])
+            arrays: Dict[str, np.ndarray] = {}
+            self._pack_blob(arrays, "page_blob", blob)
+            meta = {"kv": self._kv_meta(), "page_fetch": True,
+                    "digests": [d.hex() for d, _ in chain]}
+            return meta, arrays
+
+    def import_prefix(self, meta: dict,
+                      arrays: Dict[str, np.ndarray]) -> dict:
+        """Merge a peer's exported prefix pages into this manager's
+        cache as parked indexed pages.  Digests already held locally
+        (device index or tier) are skipped; a pool without room raises
+        the retryable :class:`KVAllocationError` BEFORE any mutation.
+        Returns ``{"pages_imported", "pages_skipped"}``."""
+        if self.prefix_cache is None:
+            return {"pages_imported": 0, "pages_skipped": 0}
+        self._check_kv_meta(meta)
+        alloc = self.kv_cache.allocator
+        blob = self._unpack_blob(arrays, "page_blob")
+        digests = [bytes.fromhex(h) for h in meta.get("digests", [])]
+        from ..snapshot import SnapshotError
+        if digests and (blob is None or blob.shape[1] != len(digests)):
+            raise SnapshotError(
+                "page-fetch blob missing or inconsistent with digests")
+        keep = []
+        for i, d in enumerate(digests):
+            if self.prefix_cache.lookup(d) is not None:
+                continue    # already warm on device
+            if self.tiers is not None and self.tiers.contains(d):
+                continue    # already warm in the tier
+            keep.append(i)
+        if not keep:
+            return {"pages_imported": 0, "pages_skipped": len(digests)}
+        if len(keep) > alloc.free_pages + alloc.parked_pages:
+            raise KVAllocationError(
+                f"page fetch needs {len(keep)} pages, pool has "
+                f"{alloc.free_pages + alloc.parked_pages} schedulable "
+                "— retry after the pool drains")
+        with trace_span("kv.import_prefix"):
+            self.ensure_free(len(keep))
+            new = self.kv_cache.restore_pages(blob_columns(blob, keep))
+            imported = 0
+            for i, p in zip(keep, new):
+                if self.prefix_cache.insert(digests[i], int(p)):
+                    self._remote_digests.add(digests[i])
+                    imported += 1
+                # park on success (indexed, refcount 0) / reclaim on a
+                # refused insert — one shared-release path does both
+                self._release_pages([int(p)])
+        return {"pages_imported": imported,
+                "pages_skipped": len(digests) - imported}
 
     # -- KV accounting ------------------------------------------------------
     def pages_needed(self, sd: SequenceDescriptor, n_new_tokens: int) -> int:
@@ -680,3 +932,17 @@ class StateManager:
                     raise RuntimeError(
                         f"KV invariant: parked page {int(p)} is not "
                         "prefix-cache indexed (leaked)")
+        if self.tiers is not None:
+            # tier accounting (ISSUE 16): host + disk + inflight ==
+            # indexed, caps respected, disk entries' files present —
+            # and nothing can be both device-indexed and tier-resident
+            # (a digest demotes only on eviction, promotes only on a
+            # device miss)
+            self.tiers.check_invariants()
+            if self.prefix_cache is not None:
+                for d, _ in self.prefix_cache.export_entries():
+                    if self.tiers.contains(d) is not None:
+                        raise RuntimeError(
+                            "KV invariant: digest indexed on device AND "
+                            "tier-resident (double-held prefix "
+                            f"{d.hex()})")

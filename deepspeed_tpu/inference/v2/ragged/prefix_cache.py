@@ -135,7 +135,15 @@ class PrefixCache:
         Entries whose page is still live occupy no extra pool space —
         they rotate to the recent end (live means in use right now), so
         repeated pressure calls don't rescan them from the front."""
-        out: List[int] = []
+        return [p for _, p in self.evict_entries(num_pages, reclaimable)]
+
+    def evict_entries(self, num_pages: int,
+                      reclaimable: Callable[[int], bool]
+                      ) -> List[Tuple[bytes, int]]:
+        """``evict`` that also returns each page's cumulative digest —
+        the demotion path (ISSUE 16) needs the digest to key the
+        host/disk tier with the same identity this index used."""
+        out: List[Tuple[bytes, int]] = []
         if num_pages <= 0:
             return out
         for _ in range(len(self._entries)):
@@ -145,7 +153,7 @@ class PrefixCache:
             if reclaimable(page):
                 del self._entries[d]
                 del self._by_page[page]
-                out.append(page)
+                out.append((d, page))
             else:
                 self._entries.move_to_end(d)
         return out

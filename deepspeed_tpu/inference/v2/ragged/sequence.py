@@ -40,6 +40,11 @@ class SequenceDescriptor:
     indexed_pages: int = 0
     #: cumulative page-hash chain cursor at ``indexed_pages``
     last_digest: bytes = b""
+    #: warm-prefix provenance (ISSUE 16): tokens attached at admission
+    #: from each tier — keys "device"/"host"/"disk"/"remote" — feeding
+    #: the workload ledger's per-request tier-hit fields; None until
+    #: match_prefix runs
+    tier_hits: Optional[dict] = None
 
     @property
     def allocated_capacity(self) -> int:

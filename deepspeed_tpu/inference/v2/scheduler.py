@@ -104,6 +104,11 @@ class Request:
     #: ledger records both so the analyzer can recommend spec_max_draft
     spec_drafted: int = 0
     spec_accepted: int = 0
+    #: warm-prefix provenance (ISSUE 16): tokens attached at admission
+    #: per tier ({"device","host","disk","remote"} -> tokens), captured
+    #: at the one-shot prefix lookup (the sequence may be flushed
+    #: before the trace-finish point); None = no lookup / all-cold
+    tier_hits: Optional[dict] = None
 
     @property
     def prefill_remaining(self) -> int:
@@ -378,7 +383,11 @@ class FastGenScheduler:
             queue_wait_ms=((req.first_sched_mono - req.submit_mono) * 1e3
                            if req.first_sched_mono else None),
             spec_drafted=req.spec_drafted,
-            spec_accepted=req.spec_accepted)
+            spec_accepted=req.spec_accepted,
+            hit_device=(req.tier_hits or {}).get("device", 0),
+            hit_host=(req.tier_hits or {}).get("host", 0),
+            hit_disk=(req.tier_hits or {}).get("disk", 0),
+            hit_remote=(req.tier_hits or {}).get("remote", 0))
 
     def _trace_token(self, req: Request) -> None:
         """Stamp one host-visible token (capture-on path only)."""
@@ -1007,6 +1016,7 @@ class FastGenScheduler:
         was_tracked = state.get_sequence(req.uid) is not None
         alloc = state.kv_cache.allocator
         parked_before = alloc.parked_pages
+        free_before = alloc.free_pages
         hit = self._engine.match_prefix(req.uid, req.prompt)
         # only consume the one-shot once the lookup actually ran —
         # match_prefix registers the sequence when it does (its own
@@ -1020,12 +1030,17 @@ class FastGenScheduler:
             adm.tracked_left -= 1
         if hit:
             req.prompt_sent = hit
-            # attached pages that were cache-parked counted as FREE in
-            # this admission's snapshot and are now live — charge
-            # exactly the parked->live transitions (already-live shared
-            # pages were never in the snapshot's free count, and an
-            # earlier same-step hit already paid for pages it revived)
-            adm.free_pages -= parked_before - alloc.parked_pages
+            req.tier_hits = self._engine.tier_hits(req.uid)
+            # attached pages that counted as schedulable in this
+            # admission's snapshot and are now live must be charged:
+            # parked->live transitions (device cache hits) AND
+            # free->live transitions (tier promotions land on freshly
+            # reserved pages, ISSUE 16); already-live shared pages were
+            # never in the snapshot's schedulable count.  Demotions a
+            # promotion triggers are parked->free — net zero here
+            adm.free_pages -= ((free_before + parked_before)
+                               - (alloc.free_pages
+                                  + alloc.parked_pages))
 
     # dslint: hot-path
     def _step_impl(self, on_token: Optional[Callable[[int, int], None]]

@@ -47,6 +47,75 @@ MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 #: [rows, page] score tile must fit alongside the fp32 accumulator)
 MAX_KERNEL_Q_ROWS = 4096
 
+#: supported serving_optimization.kv_quantization values
+KV_QUANT_FORMATS = ("none", "int8")
+
+
+@jax.tree_util.register_pytree_node_class
+class KVPages:
+    """Block-scaled int8 KV page store (ISSUE 16): the quantized twin of
+    the plain ``[..., page, 2, K, D]`` cache array.
+
+    ``payload`` holds the int8 codes at the fp layout's exact shape;
+    ``scale`` is the per-(token, kv-head) fp32 sidecar — one scale per
+    ``head_dim`` block (``payload.shape[:-1]``), the EQuARX block
+    discipline the comm path already uses.  Per-token scales mean a
+    decode append never rescales previously-written content: each
+    written row carries its own amax, so pages are immutable after
+    write exactly like the fp path (the prefix-sharing contract).
+
+    Registered as a pytree so it rides every existing seam unchanged:
+    ``lax.scan`` slices both leaves along the layer axis, ``jit``
+    donation donates both, and the engine's opaque ``kv_cache.data``
+    threading never looks inside.  ``__getitem__`` mirrors the
+    per-layer indexing of the non-scan model path."""
+
+    __slots__ = ("payload", "scale")
+
+    def __init__(self, payload, scale):
+        self.payload = payload
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.payload, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __getitem__(self, idx):
+        return KVPages(self.payload[idx], self.scale[idx])
+
+    @property
+    def shape(self):
+        return self.payload.shape
+
+    @property
+    def dtype(self):
+        return self.payload.dtype
+
+    def __repr__(self):
+        return (f"KVPages(payload={self.payload.shape}, "
+                f"scale={self.scale.shape})")
+
+
+def quantize_kv_blocks(kv: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 block quantization over the trailing ``head_dim``
+    axis: returns ``(codes int8 [..., D], scales f32 [...])`` with
+    ``codes * scales ~= kv``.  Computed in fp32 (a bf16 divide would
+    waste code points); an all-zero block gets scale 0 and codes 0."""
+    kvf = kv.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(kvf), axis=-1) / 127.0            # [...]
+    codes = jnp.round(kvf / jnp.maximum(scale, 1e-30)[..., None])
+    return (jnp.clip(codes, -127, 127).astype(jnp.int8),
+            scale.astype(jnp.float32))
+
+
+def dequantize_kv_blocks(codes: jax.Array, scale: jax.Array,
+                         dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`quantize_kv_blocks`."""
+    return (codes.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
 
 def token_positions(start_pos: jax.Array, q_len_max: int) -> jax.Array:
     """pos[s, i] = start_pos[s] + i  (int32, [S, Q])."""
@@ -58,12 +127,15 @@ def write_kv(kv_layer: jax.Array, k_new: jax.Array, v_new: jax.Array,
              q_lens: jax.Array) -> jax.Array:
     """Scatter new KV into the cache pages of one layer.
 
-    kv_layer : [num_pages+1, page_size, 2, K, D]
+    kv_layer : [num_pages+1, page_size, 2, K, D] (or :class:`KVPages`)
     k_new/v_new : [S, Q, K, D]
     Returns the updated kv_layer (functional; donate at jit boundary).
+    A quantized layer quantizes at append: codes and scales scatter at
+    the same (page, slot), so a row is always self-consistent.
     """
     S, Q = k_new.shape[:2]
-    page_size = kv_layer.shape[1]
+    quantized = isinstance(kv_layer, KVPages)
+    page_size = (kv_layer.payload if quantized else kv_layer).shape[1]
     pos = token_positions(start_pos, Q)                     # [S, Q]
     valid = jnp.arange(Q, dtype=jnp.int32)[None, :] < q_lens[:, None]
     page_idx_in_seq = pos // page_size
@@ -73,6 +145,13 @@ def write_kv(kv_layer: jax.Array, k_new: jax.Array, v_new: jax.Array,
     pages_f = pages.reshape(-1)
     slot_f = slot.reshape(-1)
     kv_new = jnp.stack([k_new, v_new], axis=2)              # [S,Q,2,K,D]
+    if quantized:
+        codes, scales = quantize_kv_blocks(kv_new)
+        return KVPages(
+            kv_layer.payload.at[pages_f, slot_f].set(
+                codes.reshape((S * Q,) + codes.shape[2:]), mode="drop"),
+            kv_layer.scale.at[pages_f, slot_f].set(
+                scales.reshape((S * Q,) + scales.shape[2:]), mode="drop"))
     kv_f = kv_new.reshape((S * Q,) + kv_new.shape[2:]).astype(kv_layer.dtype)
     return kv_layer.at[pages_f, slot_f].set(kv_f, mode="drop")
 
@@ -101,7 +180,9 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
     Pallas interpret mode (CPU testing), independent of path selection.
     """
     S, Q, H, D = q.shape
-    K_heads = kv_layer.shape[3]
+    quantized = isinstance(kv_layer, KVPages)
+    kv_arr = kv_layer.payload if quantized else kv_layer
+    K_heads = kv_arr.shape[3]
     if use_kernel is None:
         use_kernel = ((interpret or jax.default_backend() == "tpu")
                       and Q * (H // K_heads) <= MAX_KERNEL_Q_ROWS)
@@ -110,12 +191,17 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
             q, kv_layer, page_table, start_pos,
             sm_scale=sm_scale, alibi_slopes=alibi_slopes,
             window=window, interpret=interpret)
-    page_size = kv_layer.shape[1]
-    K = kv_layer.shape[3]
+    page_size = kv_arr.shape[1]
+    K = kv_arr.shape[3]
     G = H // K
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
 
-    pages = kv_layer[page_table]                # [S, P, page, 2, K, D]
+    pages = kv_arr[page_table]                  # [S, P, page, 2, K, D]
+    if quantized:
+        # dequantize the gathered context only — the resident cache
+        # stays int8; [S, P, page, 2, K] scales broadcast over D
+        pages = dequantize_kv_blocks(
+            pages, kv_layer.scale[page_table], dtype=q.dtype)
     P = pages.shape[1]
     C = P * page_size
     k = pages[..., 0, :, :].reshape(S, C, K, D)
@@ -152,7 +238,7 @@ def paged_attention(q: jax.Array, kv_layer: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
-                   sm_scale, has_alibi, window, q_len, groups):
+                   sm_scale, has_alibi, has_scale, window, q_len, groups):
     """One (slot, kv_head, page) grid step of flash-style ragged attention.
 
     q_ref : [Q*G, D]       (this slot's queries for one kv head; row
@@ -160,6 +246,11 @@ def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
                             ctx_len_r = start_pos + r // G + 1)
     k_ref/v_ref : [page_size, D]  (one cache page, DMA'd via the page
                             table — see the index maps in the caller)
+    ks_ref/vs_ref : [page_size, 1]  per-token block scales — present
+                            ONLY when ``has_scale`` (quantized int8
+                            pages, ISSUE 16): the page dequantizes in
+                            VMEM right after its one DMA, so HBM
+                            traffic stays int8-sized
     slopes_ref : [1, G]    per-q-head ALiBi slopes — present ONLY when
                             ``has_alibi`` (the kernel is specialized
                             statically so non-ALiBi models pay nothing)
@@ -171,11 +262,14 @@ def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
     Scratch m/l/acc carry the running max / denominator / weighted sum
     across the page axis (the innermost, sequential grid dim).
     """
-    if has_alibi:
-        slopes_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    rest = list(refs)
+    slopes_ref = rest.pop(0) if has_alibi else None
+    if has_scale:
+        q_ref, k_ref, ks_ref, v_ref, vs_ref = rest[:5]
+        o_ref, m_scr, l_scr, acc_scr = rest[5:]
     else:
-        slopes_ref = None
-        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = rest
     s = pl.program_id(0)
     p = pl.program_id(2)
     rows = q_len * groups
@@ -198,7 +292,12 @@ def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
     @pl.when(page_valid)
     def _attend():
         q = q_ref[:]                                   # [Q*G, D]
-        k = k_ref[:]                                   # [page, D]
+        if has_scale:
+            # block dequant in VMEM: codes [page, D] * scales [page, 1]
+            k = (k_ref[:].astype(jnp.float32)
+                 * ks_ref[:]).astype(q_ref.dtype)
+        else:
+            k = k_ref[:]                               # [page, D]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [Q*G, page]
@@ -227,9 +326,16 @@ def _decode_kernel(pt_ref, sp_ref, *refs, page_size, num_pages_per_seq,
         alpha = jnp.exp(m_prev - m_new)
         m_scr[:] = m_new
         l_scr[:] = l_prev * alpha + jnp.sum(pexp, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            pexp.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if has_scale:
+            vv = v_ref[:].astype(jnp.float32) * vs_ref[:]  # [page, D]
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                pexp, vv, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                pexp.astype(v_ref.dtype), v_ref[:],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
     @pl.when(p == num_pages_per_seq - 1)
     def _finish():
@@ -258,8 +364,10 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
     page_table: [S, P]; start_pos: [S].  Returns [S, Q, H, D].
     """
     S, Q, H, D = q.shape
-    page_size = kv_layer.shape[1]
-    K = kv_layer.shape[3]
+    has_scale = isinstance(kv_layer, KVPages)
+    kv_arr = kv_layer.payload if has_scale else kv_layer
+    page_size = kv_arr.shape[1]
+    K = kv_arr.shape[3]
     G = H // K
     P_pages = page_table.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
@@ -280,8 +388,19 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
     o_spec = pl.BlockSpec((None, None, Q * G, D),
                           lambda s, k, p, pt, sp: (s, k, 0, 0))
 
-    in_specs = [q_spec, k_spec, v_spec]
-    inputs = (qg, kv_layer, kv_layer)
+    if has_scale:
+        # scale sidecar [P+1, page, 2, K] -> [page, 1] block per (p, k):
+        # the same page-table indirection as k/v, 2-D refs (Mosaic
+        # rejects in-kernel gathers; the BlockSpec DMA does the gather)
+        ks_spec = pl.BlockSpec((None, page_size, None, 1),
+                               lambda s, k, p, pt, sp: (pt[s, p], 0, 0, k))
+        vs_spec = pl.BlockSpec((None, page_size, None, 1),
+                               lambda s, k, p, pt, sp: (pt[s, p], 0, 1, k))
+        in_specs = [q_spec, k_spec, ks_spec, v_spec, vs_spec]
+        inputs = (qg, kv_arr, kv_layer.scale, kv_arr, kv_layer.scale)
+    else:
+        in_specs = [q_spec, k_spec, v_spec]
+        inputs = (qg, kv_arr, kv_arr)
     if has_alibi:
         slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(K, 1, G)
         sl_spec = pl.BlockSpec((None, 1, G),
@@ -291,8 +410,8 @@ def paged_decode_attention(q: jax.Array, kv_layer: jax.Array,
 
     kernel = functools.partial(
         _decode_kernel, page_size=page_size, num_pages_per_seq=P_pages,
-        sm_scale=scale, has_alibi=has_alibi, window=window,
-        q_len=Q, groups=G)
+        sm_scale=scale, has_alibi=has_alibi, has_scale=has_scale,
+        window=window, q_len=Q, groups=G)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -356,8 +475,13 @@ def attention_reference(q, k_ctx, v_ctx, start_pos, q_lens,
 
 def paged_context(kv_layer: jax.Array, page_table: jax.Array
                   ) -> Tuple[jax.Array, jax.Array]:
-    """Materialize a slot's context (testing helper)."""
-    pages = kv_layer[page_table]
+    """Materialize a slot's context (testing helper); a quantized layer
+    dequantizes to fp32."""
+    if isinstance(kv_layer, KVPages):
+        pages = dequantize_kv_blocks(kv_layer.payload[page_table],
+                                     kv_layer.scale[page_table])
+    else:
+        pages = kv_layer[page_table]
     S, P, page_size = pages.shape[:3]
     k = pages[..., 0, :, :].reshape(S, P * page_size, *pages.shape[4:])
     v = pages[..., 1, :, :].reshape(S, P * page_size, *pages.shape[4:])

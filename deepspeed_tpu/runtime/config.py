@@ -507,6 +507,19 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
     #: mined lattice artifact (analyze_trace --emit-lattice) or mines a
     #: raw workload trace at engine build
     lattice: str = ""
+    # -- tiered KV at fleet scale (ISSUE 16) ---------------------------
+    #: KV page storage: "none" (fp pages) or "int8" (block-scaled
+    #: codes + per-head_dim-block fp32 scales) — ~2x resident
+    #: sequences per chip; engine-build-time
+    kv_quantization: str = "none"
+    #: host DRAM prefix tier size in pages (0 = tier off): evicted
+    #: parked pages demote here instead of being freed, keyed by their
+    #: chained prefix digests, and promote back on a prefix match
+    kv_tier_host_pages: int = 0
+    #: disk prefix tier below the host ring (pages; 0 = off)
+    kv_tier_disk_pages: int = 0
+    #: directory for disk-tier page files ("" = per-process temp dir)
+    kv_tier_dir: str = ""
 
     def to_v2_dict(self) -> Dict[str, Any]:
         """The ``serving_optimization`` dict the inference-v2 config
@@ -527,7 +540,11 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
                 "role": self.role,
                 "keyed_sampling": self.keyed_sampling,
                 "compile_cache_dir": self.compile_cache_dir,
-                "lattice": self.lattice}
+                "lattice": self.lattice,
+                "kv_quantization": self.kv_quantization,
+                "kv_tier_host_pages": self.kv_tier_host_pages,
+                "kv_tier_disk_pages": self.kv_tier_disk_pages,
+                "kv_tier_dir": self.kv_tier_dir}
 
 
 class TPUConfig(DeepSpeedConfigModel):

@@ -102,6 +102,9 @@ SITES: Dict[str, str] = {
         "raise a transient collective failure at train-step dispatch",
     "ckpt.io_error": "raise OSError inside checkpoint save/latest write",
     "kv.alloc_oom": "raise KVAllocationError from KV-page allocation",
+    "kv.tier_io_error":
+        "raise OSError inside KV tier demotion/spill/promotion I/O "
+        "(degrades to a clean tier miss, never a corrupt hit)",
     "fastgen.poison_request":
         "raise inside one serving request's admission path",
     "serving.preempt":

@@ -143,7 +143,8 @@ class ReplicaPool:
                  hint_every: int = 4,
                  min_replicas: int = 1,
                  max_replicas: int = 8,
-                 warm_spawn: bool = True):
+                 warm_spawn: bool = True,
+                 page_fetch_margin: int = -1):
         """``factory(label)`` builds one fresh replica (engine +
         scheduler) — also the ``scale_up`` spawn path, so it must
         return an INDEPENDENT engine per call.  With ``warm_spawn``
@@ -169,6 +170,11 @@ class ReplicaPool:
         self._next_label = 0
         self._router: Optional[PrefixAffinityRouter] = None
         self._policy = policy
+        #: ISSUE 16 cross-replica page fetch: when >= 0, an affinity
+        #: match losing to least-backlog by more than this margin
+        #: streams its matched pages to the chosen replica instead of
+        #: recomputing the prefill (-1 = off, pure PR 12 affinity)
+        self._page_fetch_margin = int(page_fetch_margin)
         # -- SLO subscription (PR 11 evaluator) ------------------------------
         self._slo = None
         self._slo_cooldown_s = 5.0
@@ -211,7 +217,8 @@ class ReplicaPool:
                 # page size is an engine fact; the first replica fixes it
                 self._router = PrefixAffinityRouter(
                     rep.engine.model.kv_config.page_size,
-                    top_k=self._hint_top_k, policy=self._policy)
+                    top_k=self._hint_top_k, policy=self._policy,
+                    fetch_backlog_margin=self._page_fetch_margin)
             tm.POOL_REPLICAS.set(len(self._live()))
         if count_scale_up:
             tm.POOL_SCALE_UP.inc()
@@ -293,6 +300,8 @@ class ReplicaPool:
             tm.POOL_AFFINITY_ROUTED.inc()
         req.replica = decision.label
         req.matched_pages = decision.matched_pages
+        if decision.fetch_from:
+            self._fetch_pages(rep, decision)
         with rep.lock:
             verdict = rep.scheduler.submit(req.uid, prompt, params,
                                            ttl_s=ttl_s)
@@ -302,6 +311,49 @@ class ReplicaPool:
                                      tokens=list(req.tokens))
             req.finished_mono = time.monotonic()
         return verdict
+
+    def _fetch_pages(self, rep: _Replica,
+                     decision: RouteDecision) -> None:
+        """Stream the matched committed prefix pages replica-to-replica
+        (ISSUE 16 tentpole c) through the same (meta, named numpy
+        arrays) codec as the disagg handoff: export under the peer's
+        lock, import under the target's — two SEPARATE critical
+        sections, never nested, so opposite-direction fetches can't
+        deadlock.  Best-effort: any failure (dead peer, stale hint,
+        full target pool) just means the request prefills its prefix
+        like a cold placement."""
+        src = self._replicas.get(decision.fetch_from)
+        if src is None or not src.alive:
+            return
+        t0 = time.monotonic()
+        try:
+            with src.lock:
+                exported = src.engine.export_prefix(
+                    decision.fetch_digests)
+            if exported is None:
+                return      # stale hint: the peer evicted the pages
+            meta, arrays = exported
+            with rep.lock:
+                stats = rep.engine.import_prefix(meta, arrays)
+        except Exception as e:  # noqa: BLE001 — the fetch is an
+            # optimization; the recompute path is always correct
+            from ..utils.logging import logger
+            logger.warning(
+                "pool: page fetch %s -> %s failed (%s: %s) — request "
+                "prefills cold", decision.fetch_from, rep.label,
+                type(e).__name__, e)
+            return
+        elapsed_ms = (time.monotonic() - t0) * 1000.0
+        pages = int(stats.get("pages_imported", 0))
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        tm.POOL_PAGE_FETCHES.inc()
+        tm.POOL_PAGE_FETCH_PAGES.inc(pages)
+        tm.POOL_PAGE_FETCH_BYTES.inc(nbytes)
+        tm.POOL_PAGE_FETCH_MS.observe(elapsed_ms)
+        get_flight_recorder().record(
+            "pool.page_fetch", src=decision.fetch_from, dst=rep.label,
+            pages=pages, skipped=int(stats.get("pages_skipped", 0)),
+            bytes=nbytes)
 
     def submit(self, uid: int, prompt: Sequence[int],
                params: Optional[SamplingParams] = None,

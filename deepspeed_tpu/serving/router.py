@@ -52,13 +52,22 @@ class RouteDecision:
     label: str
     matched_pages: int = 0
     reason: str = "backlog"
+    #: cross-replica page fetch hint (ISSUE 16): when affinity lost to
+    #: least-backlog, the peer that DID match — the chosen replica can
+    #: stream the matched committed pages from it instead of
+    #: recomputing the prefill
+    fetch_from: Optional[str] = None
+    #: the leading cumulative digest chain (hex, root first) the peer
+    #: matched — exactly what ``StateManager.export_prefix`` consumes
+    fetch_digests: List[str] = dataclasses.field(default_factory=list)
 
 
 class PrefixAffinityRouter:
     """Route prompts to the replica already holding their prefix."""
 
     def __init__(self, page_size: int, top_k: int = 64,
-                 policy: str = "affinity"):
+                 policy: str = "affinity",
+                 fetch_backlog_margin: int = -1):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if policy not in POLICIES:
@@ -66,6 +75,13 @@ class PrefixAffinityRouter:
         self.page_size = int(page_size)
         self.top_k = int(top_k)
         self.policy = policy
+        #: ISSUE 16: when >= 0, an affinity match whose replica is
+        #: backlogged more than ``margin`` requests past the
+        #: least-loaded replica LOSES the placement — the request goes
+        #: to least-backlog carrying a ``fetch_from`` hint so the
+        #: matched pages stream over instead of being recomputed.
+        #: -1 keeps the pure affinity-first rule (PR 12 behavior)
+        self.fetch_backlog_margin = int(fetch_backlog_margin)
         self._lock = threading.RLock()
         #: label -> published digest hints (set for O(1) chain walk)
         self._hints: Dict[str, set] = {}
@@ -164,6 +180,20 @@ class PrefixAffinityRouter:
                                           < backlogs[best]):
                         best, best_match = label, m
                 if best is not None and best_match > 0:
+                    least = min(labels,
+                                key=lambda lb: (backlogs[lb], lb))
+                    if (self.fetch_backlog_margin >= 0
+                            and least != best
+                            and backlogs[best] - backlogs[least]
+                            > self.fetch_backlog_margin):
+                        # affinity loses to least-backlog (ISSUE 16):
+                        # place on the idle replica, but hand it the
+                        # matched peer + digest chain so the pool can
+                        # FETCH the pages instead of recomputing them
+                        self._note_heat(digests[0], least)
+                        return RouteDecision(
+                            least, 0, "backlog", fetch_from=best,
+                            fetch_digests=digests[:best_match])
                     self._note_heat(digests[0], best)
                     return RouteDecision(best, best_match, "affinity")
             label = min(labels, key=lambda lb: (backlogs[lb], lb))

@@ -63,8 +63,9 @@ EVENT_KINDS = frozenset({
     "disagg.build", "disagg.handoff", "disagg.handoff_ready",
     "engine.build", "engine.destroy",
     "fastgen.reopen", "fastgen.restore", "fastgen.snapshot",
-    "kv.alloc_fail", "kv.evict",
-    "pool.advice_applied", "pool.build", "pool.rebalance",
+    "kv.alloc_fail", "kv.demote", "kv.evict", "kv.promote",
+    "pool.advice_applied", "pool.build", "pool.page_fetch",
+    "pool.rebalance",
     "pool.replica_add", "pool.replica_death", "pool.scale_down",
     "pool.warm_spawn",
     "request.admit", "request.done", "request.error",

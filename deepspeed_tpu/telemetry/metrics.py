@@ -65,6 +65,30 @@ KV_PARKED_PAGES = registry.gauge(
 KV_TOTAL_PAGES = registry.gauge(
     "ds_kv_total_pages", "KV pool size in pages")
 
+# -- tiered KV prefix store (ISSUE 16) ---------------------------------------
+KV_TIER_HOST_PAGES = registry.gauge(
+    "ds_kv_tier_host_pages",
+    "prefix pages resident in the host DRAM tier ring")
+KV_TIER_DISK_PAGES = registry.gauge(
+    "ds_kv_tier_disk_pages",
+    "prefix pages resident in the disk tier")
+KV_TIER_DEMOTED = registry.counter(
+    "ds_kv_tier_demoted_total",
+    "parked prefix pages demoted device -> host tier instead of being "
+    "freed under pool pressure")
+KV_TIER_PROMOTED = registry.counter(
+    "ds_kv_tier_promoted_total",
+    "prefix pages promoted from the host/disk tier back onto device "
+    "at prefix-match time")
+KV_TIER_IO_ERRORS = registry.counter(
+    "ds_kv_tier_io_errors_total",
+    "tier demotion/promotion I/O failures degraded to a clean miss "
+    "(torn entries dropped, never served)")
+KV_TIER_PROMOTE_MS = registry.histogram(
+    "ds_kv_tier_promote_ms",
+    "wall time of one tier promotion batch (host/disk read + device "
+    "scatter), overlapped behind the uncached-suffix prefill")
+
 # -- training throughput ----------------------------------------------------
 TRAIN_SAMPLES_PER_SEC = registry.gauge(
     "ds_train_samples_per_sec", "ThroughputTimer samples/s")
@@ -266,6 +290,23 @@ POOL_REPLICA_DEATHS = registry.counter(
     "ds_pool_replica_deaths_total",
     "replicas that died abruptly (preemption/kill) and had their "
     "tracked requests resubmitted to survivors")
+
+# -- cross-replica page fetch (ISSUE 16) --------------------------------------
+POOL_PAGE_FETCHES = registry.counter(
+    "ds_pool_page_fetches_total",
+    "affinity-miss placements that streamed matched prefix pages from "
+    "the best-match peer replica instead of recomputing prefill")
+POOL_PAGE_FETCH_PAGES = registry.counter(
+    "ds_pool_page_fetch_pages_total",
+    "KV pages streamed replica-to-replica by cross-replica page fetch")
+POOL_PAGE_FETCH_BYTES = registry.counter(
+    "ds_pool_page_fetch_bytes_total",
+    "bytes of page payload + scales crossing the cross-replica fetch "
+    "seam")
+POOL_PAGE_FETCH_MS = registry.histogram(
+    "ds_pool_page_fetch_ms",
+    "wall time of one cross-replica page fetch (peer export -> local "
+    "import)")
 
 # -- disaggregated prefill/decode serving (ISSUE 13) --------------------------
 DISAGG_HANDOFFS = registry.counter(

@@ -180,12 +180,20 @@ class WorkloadTrace:
                        itl_ms: Optional[float],
                        queue_wait_ms: Optional[float],
                        spec_drafted: int = 0,
-                       spec_accepted: int = 0) -> None:
+                       spec_accepted: int = 0,
+                       hit_device: int = 0,
+                       hit_host: int = 0,
+                       hit_disk: int = 0,
+                       hit_remote: int = 0) -> None:
         """One terminated request (scheduler drain/error point).  Only
         lengths, digests, params, latencies and speculation counts —
         never token ids.  ``spec_drafted``/``spec_accepted`` are this
         request's speculative-decoding facts (ISSUE 10): the analyzer
-        mines accept rates from them to recommend ``spec_max_draft``."""
+        mines accept rates from them to recommend ``spec_max_draft``.
+        ``hit_device``/``hit_host``/``hit_disk``/``hit_remote`` are the
+        request's warm-prefix tokens by tier of origin (ISSUE 16) — the
+        analyzer's tier-hit report sizes the host/disk tiers from
+        them."""
         if not self.active:
             return
         rec = {
@@ -206,6 +214,10 @@ class WorkloadTrace:
                               else round(queue_wait_ms, 3)),
             "spec_drafted": int(spec_drafted),
             "spec_accepted": int(spec_accepted),
+            "hit_device": int(hit_device),
+            "hit_host": int(hit_host),
+            "hit_disk": int(hit_disk),
+            "hit_remote": int(hit_remote),
         }
         with self._lock:
             if not self.active:

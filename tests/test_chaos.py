@@ -151,7 +151,8 @@ class TestFaultInjectorRegistry:
         assert set(SITES) == {
             "train.nan_grad", "train.slow_step",
             "comm.collective_failure", "ckpt.io_error", "kv.alloc_oom",
-            "fastgen.poison_request", "serving.preempt"}
+            "fastgen.poison_request", "serving.preempt",
+            "kv.tier_io_error"}
 
 
 # ---------------------------------------------------------------------------

@@ -119,7 +119,8 @@ def _workload(eng, n=8, seed=0, max_new=6, shared_pages=2,
 REQUEST_KEYS = {"kind", "uid", "arrival_s", "prompt_len", "gen_len",
                 "digests", "temperature", "top_k", "top_p",
                 "max_new_tokens", "outcome", "ttft_ms", "itl_ms",
-                "queue_wait_ms", "spec_drafted", "spec_accepted"}
+                "queue_wait_ms", "spec_drafted", "spec_accepted",
+                "hit_device", "hit_host", "hit_disk", "hit_remote"}
 
 
 class TestLedger:

@@ -188,6 +188,30 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
                  "rates"),
     }
 
+    # -- tier mining (ISSUE 16): the per-request hit_device/host/disk/
+    # remote token attribution the scheduler writes at finish makes
+    # tier sizing minable from a replayed trace the same way lattice
+    # keys are: a big host-tier token share says grow the host ring, a
+    # big disk share says promotions are eating disk reads, a big
+    # remote share says affinity routing is losing placements ---------
+    hit_fields = ("device", "host", "disk", "remote")
+    hits = {t: sum(int(r.get(f"hit_{t}", 0)) for r in requests)
+            for t in hit_fields}
+    prompt_total = sum(prompt_lens) or 1
+    tiers = {
+        "hit_tokens": hits,
+        "hit_rate": {t: round(hits[t] / prompt_total, 4)
+                     for t in hit_fields},
+        "prefix_hit_rate": round(sum(hits.values()) / prompt_total, 4),
+        "requests_with_tier_hits": sum(
+            1 for r in requests
+            if any(int(r.get(f"hit_{t}", 0)) for t in hit_fields[1:])),
+        "note": (None if any(hits.values()) else
+                 "no tier-hit attribution in this trace — captured "
+                 "before the tiered-KV ledger fields existed, or "
+                 "prefix caching / kv_tier_* were off"),
+    }
+
     return {
         "meta": {k: v for k, v in meta.items() if k != "kind"},
         "requests": {
@@ -220,6 +244,7 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
             "uncovered_by_current": [list(k) for k in uncovered],
         },
         "speculation": speculation,
+        "tiers": tiers,
         "recommended_lattice": {
             "page_size": page,
             "s_buckets": s_buckets,

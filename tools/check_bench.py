@@ -48,8 +48,11 @@ _LATENCY_RE = re.compile(r"_ms$")
 #: disagg_* rides the fleet tolerances too: its handoff latency and
 #: per-pool rates are scheduling-interleave sensitive on CPU debug;
 #: coldstart_* spans subprocess spawns + disk I/O (ISSUE 14) — the
-#: in-round coldstart_findings gate carries the hard invariants
-_FLEET_RE = re.compile(r"^(fastgen_fleet_|pool_|disagg_|coldstart_)")
+#: in-round coldstart_findings gate carries the hard invariants;
+#: tier_* spans disk AIO + replica-to-replica transfer timing
+#: (ISSUE 16) — its hard invariants live in tier_findings
+_FLEET_RE = re.compile(
+    r"^(fastgen_fleet_|pool_|disagg_|coldstart_|tier_)")
 #: parsed keys that are not a measured quantity at all
 _SKIP_RE = re.compile(
     r"(^metric$|^unit$|error|^cpu_fallback$|_model$|_path$|_policy$|"
@@ -217,6 +220,61 @@ def disagg_findings(cur: Dict) -> List[str]:
     return out
 
 
+def tier_findings(cur: Dict) -> List[str]:
+    """In-round tiered-KV gate (ISSUE 16): int8 pages must fund >=
+    1.7x resident sequences at the same device byte budget, TTFT p99
+    with int8 on must stay flat (not grow >15% over the fp baseline
+    at that budget), the warm wave must actually hit the host/disk
+    tier (a returning prefix is a promotion, not a recompute, and not
+    a silent corruption — the replay asserts tokenwise parity
+    upstream), a cross-replica fetch must beat recomputing the same
+    prefix, and the measured passes must not compile on-path."""
+    out: List[str] = []
+    if "tier_resident_seq_ratio" not in cur:
+        return out      # leg didn't run this round
+    ratio = cur.get("tier_resident_seq_ratio")
+    if isinstance(ratio, (int, float)) and ratio < 1.7:
+        out.append(f"int8 KV pages fund only {ratio}x resident "
+                   "sequences at an equal device byte budget "
+                   "(target >= 1.7x) — check "
+                   "KVCacheConfig.bytes_per_page accounting")
+    before = cur.get("tier_ttft_p99_before_ms")
+    after = cur.get("tier_ttft_p99_after_ms")
+    if (isinstance(before, (int, float)) and before > 0
+            and isinstance(after, (int, float))
+            and after > before * 1.15):
+        out.append(f"TTFT p99 with int8 KV is {after / before:.2f}x "
+                   f"the fp baseline at the same byte budget "
+                   f"({after} vs {before} ms; target <= 1.15x) — "
+                   "dequantization is eating the capacity win")
+    host = cur.get("tier_host_hit_rate")
+    disk = cur.get("tier_disk_hit_rate")
+    if (isinstance(host, (int, float)) and isinstance(disk, (int, float))
+            and host + disk <= 0):
+        out.append("the warm wave never hit the host/disk tier — "
+                   "returning prefixes are recomputing instead of "
+                   "promoting (demotion or digest chaining broken?)")
+    promoted = cur.get("tier_promoted_pages")
+    if isinstance(promoted, (int, float)) and promoted <= 0:
+        out.append("the tiered engine promoted zero pages across the "
+                   "warm waves — the device-starved replay should "
+                   "force promotions")
+    fetch = cur.get("tier_fetch_ttft_ms")
+    rec = cur.get("tier_recompute_ttft_ms")
+    if (isinstance(fetch, (int, float)) and isinstance(rec, (int, float))
+            and rec > 0 and fetch >= rec):
+        out.append(f"cross-replica page fetch ({fetch} ms TTFT) did "
+                   f"not beat recompute-prefill ({rec} ms) on an "
+                   "affinity-miss — streaming committed pages should "
+                   "be cheaper than re-prefilling the prefix")
+    comp = cur.get("tier_compile_on_path_total")
+    if isinstance(comp, (int, float)) and comp > 0:
+        out.append(f"tier bench measured passes compiled {comp} "
+                   "program(s) on-path (warmup no longer covers the "
+                   "quantized/tier-warmed key set)")
+    return out
+
+
 def coldstart_findings(cur: Dict) -> List[str]:
     """In-round cold-start gate (ISSUE 14).  The recompile-proof
     invariants (zero on-path compiles, zero true compiles, tokenwise
@@ -295,6 +353,7 @@ def main(argv=None) -> int:
     findings += [("note", m) for m in spec_findings(cur)]
     findings += [("note", m) for m in pool_findings(cur)]
     findings += [("note", m) for m in disagg_findings(cur)]
+    findings += [("note", m) for m in tier_findings(cur)]
     findings += [("note", m) for m in coldstart_findings(cur)]
     regressions = [m for sev, m in findings if sev == "regression"]
     notes = [m for sev, m in findings if sev == "note"]

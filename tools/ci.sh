@@ -32,6 +32,16 @@
 #                      checked-in trace; one replica is drain-migrated
 #                      away mid-replay; asserts exact gen-length parity
 #                      and ZERO lost requests
+#   3b. tier smoke   — tools/replay_trace.py --tier --check
+#                      (ISSUE 16): the first 24 requests replayed
+#                      TWICE on one device-starved engine (a 4-page
+#                      device cache request, clamped to the smallest
+#                      schedulable pool) backed by a tiny host ring
+#                      spilling to a disk tier; asserts structural
+#                      parity, demotions + disk spills + promotions
+#                      actually happened, warm-from-tier tokens ==
+#                      cold tokens (keyed sampling), and the store's
+#                      host+disk+inflight == indexed accounting
 #   5b. disagg smoke — tools/replay_trace.py --disagg --check
 #                      (ISSUE 13): the same 32 requests through the
 #                      two-pool prefill/decode scheduler with
@@ -75,6 +85,10 @@ python -m pytest tests/ -q -m chaos -p no:cacheprovider
 echo "== workload replay smoke (incl. speculative pass) =="
 python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
     --limit 32 --spec --check > /dev/null
+
+echo "== tiered-KV smoke (4-page device cache forcing demotion) =="
+python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
+    --limit 24 --tier --tier-device-pages 4 --check > /dev/null
 
 echo "== fleetctl federation smoke =="
 python tools/fleetctl.py --smoke

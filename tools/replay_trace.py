@@ -234,7 +234,8 @@ def build_disagg_engines(meta: Dict[str, Any],
 def replay(engine, requests: List[Dict[str, Any]],
            prompts: List[np.ndarray], speed: float = 0.0,
            token_budget: Optional[int] = None,
-           serving=None, on_token=None) -> Dict[str, Any]:
+           serving=None, on_token=None,
+           capture: bool = False) -> Dict[str, Any]:
     """Re-issue the trace against a fresh FastGenScheduler on
     ``engine``.  ``speed=0`` submits everything up front (as fast as
     the scheduler drains); ``speed>0`` paces submissions at the
@@ -243,11 +244,19 @@ def replay(engine, requests: List[Dict[str, Any]],
     generated lengths reproduce exactly regardless of sampled values.
     Returns the replayed facts: per-request gen lengths, TTFT/queue
     percentiles, decode tok/s, and the measured-window recompile
-    counters."""
+    counters.  ``capture=True`` leaves the workload ledger LIVE for
+    the drive — the caller has configured a private ledger and wants
+    the replay's own request records (the tier bench mines the
+    per-request ``hit_device/host/disk/remote`` attribution exactly
+    the way tools/analyze_trace.py would)."""
     from deepspeed_tpu.inference.v2 import FastGenScheduler, SamplingParams
     from deepspeed_tpu.telemetry import metrics as tm
     from deepspeed_tpu.telemetry.workload_trace import get_workload_trace
 
+    if capture:
+        return _replay_impl(FastGenScheduler, SamplingParams, tm,
+                            engine, requests, prompts, speed,
+                            token_budget, serving, on_token)
     # a live ledger (DS_WORKLOAD_TRACE still exported on the capture
     # machine) must not record the replay's own synthetic traffic into
     # the trace being studied — capture is suspended for the drive
@@ -662,6 +671,372 @@ def run_disagg_bench(trace_path: Optional[str] = None,
     return out
 
 
+# -- the tiered-KV replay legs (ISSUE 16) ------------------------------------
+def build_tier_engine(meta: Dict[str, Any],
+                      requests: List[Dict[str, Any]],
+                      device_pages: int = 4,
+                      host_pages: int = 8,
+                      disk_pages: int = 256,
+                      tier_dir: str = "",
+                      model_size: str = "debug",
+                      max_seqs: int = 2,
+                      quant: str = "none"):
+    """A deliberately device-starved replay engine backed by the
+    host/disk prefix tier: the device pool is clamped to the smallest
+    SCHEDULABLE size >= ``device_pages`` (one worst-case sequence plus
+    a landing page — a 7-page request cannot run inside a literal
+    4-page pool), so parked prefix pages are evicted -> DEMOTED almost
+    immediately and a returning prefix must come back through tier
+    promotion, not a device hit.  Keyed sampling makes replayed token
+    values schedule-invariant, so callers can assert warm-from-tier ==
+    cold tokenwise even on the trace's sampled requests."""
+    from deepspeed_tpu.inference.v2 import ServingOptimizationConfig
+    cfg, params, page, need = _replay_model_parts(meta, requests,
+                                                  model_size)
+    per_seq = -(-need // page)
+    # every ADMITTED sequence pins its matched/promoted prefix pages,
+    # so the schedulable floor is the worst-case active set, not one
+    # sequence: below it, warm admissions livelock holding each
+    # other's landing pages
+    num_pages = max(int(device_pages), max_seqs * (per_seq + 1))
+    serving = ServingOptimizationConfig(
+        keyed_sampling=True, kv_quantization=quant,
+        kv_tier_host_pages=host_pages, kv_tier_disk_pages=disk_pages,
+        kv_tier_dir=tier_dir)
+    return _build_engine(cfg, params, page, need, num_pages, max_seqs,
+                         serving=serving)
+
+
+def run_tier_smoke(trace_path: str, limit: int = 0,
+                   include_errors: bool = False,
+                   device_pages: int = 4, host_pages: int = 8,
+                   disk_pages: int = 256,
+                   model_size: str = "debug", seed: int = 0,
+                   tolerance: float = 4.0) -> Dict[str, Any]:
+    """The CI tier smoke (ISSUE 16): two replays of the same trace on
+    ONE device-starved tiered engine.  Wave 1 prefills cold and every
+    parked prefix page demotes (device -> host ring -> disk via AIO);
+    wave 2 resubmits the same requests, so every returning prefix must
+    be served back through promotion.  ``diff`` carries the usual
+    structural-parity verdict plus the tier invariants ``--check``
+    enforces: demotions and disk spills actually happened, wave 2
+    promoted pages back, wave-2 tokens are exactly wave-1's (keyed
+    sampling: warm-from-tier == cold), and the store's accounting
+    (host + disk + inflight == indexed) holds."""
+    import shutil
+    import tempfile
+
+    trace = load_trace(trace_path)
+    requests = trace["requests"]
+    if not include_errors:
+        requests = [r for r in requests if r.get("outcome") == "ok"]
+    if limit:
+        requests = requests[:limit]
+    if not requests:
+        raise ValueError(f"{trace_path}: no replayable requests")
+    meta = trace["meta"]
+    page = int(meta.get("page_size", 16))
+    tier_dir = tempfile.mkdtemp(prefix="ds_tier_smoke_")
+    engine = None
+    try:
+        engine = build_tier_engine(
+            meta, requests, device_pages=device_pages,
+            host_pages=host_pages, disk_pages=disk_pages,
+            tier_dir=tier_dir, model_size=model_size)
+        vocab = min(int(meta.get("vocab_size", 0))
+                    or engine.model.cfg.vocab_size,
+                    engine.model.cfg.vocab_size)
+        prompts = synthesize_prompts(requests, page, vocab, seed=seed)
+        tok1: Dict[int, List[int]] = {}
+        tok2: Dict[int, List[int]] = {}
+        rep1 = replay(engine, requests, prompts,
+                      on_token=lambda u, t: tok1.setdefault(
+                          u, []).append(t))
+        tiers = engine.state_manager.tiers
+        stats1 = tiers.stats()
+        rep2 = replay(engine, requests, prompts,
+                      on_token=lambda u, t: tok2.setdefault(
+                          u, []).append(t))
+        stats2 = tiers.stats()
+        verdict = diff_replay(requests, prompts, page, rep2,
+                              tolerance=tolerance)
+        problems = list(verdict["problems"])
+        if stats1["demoted_pages"] <= 0:
+            problems.append(
+                "[tier] wave 1 demoted no pages — the device-starved "
+                "pool should have evicted every parked prefix page "
+                "into the host tier")
+        if disk_pages > 0 and stats2["spilled_pages"] <= 0:
+            problems.append(
+                "[tier] nothing spilled host -> disk although a disk "
+                "tier was configured and the host ring is tiny")
+        if stats2["promoted_pages"] <= stats1["promoted_pages"]:
+            problems.append(
+                "[tier] wave 2 promoted no pages — returning prefixes "
+                "recomputed instead of warming from the tier")
+        if tok2 != tok1:
+            diff_uids = sorted(u for u in tok1
+                               if tok1.get(u) != tok2.get(u))
+            problems.append(
+                f"[tier] warm-from-tier tokens differ from cold for "
+                f"request(s) {diff_uids[:8]} — promotion corrupted "
+                "page contents")
+        try:
+            tiers.check_invariants()
+        except RuntimeError as e:
+            problems.append(f"[tier] store accounting broken: {e}")
+        verdict = dict(verdict, problems=problems,
+                       structural_ok=not problems)
+        return {"trace": trace_path, "meta": meta,
+                "requests": len(requests),
+                "device_pages": engine.model.kv_config.num_pages,
+                "wave1": rep1, "replay": rep2,
+                "tier": stats2, "diff": verdict}
+    finally:
+        if engine is not None:
+            engine.state_manager.close()
+        shutil.rmtree(tier_dir, ignore_errors=True)
+
+
+def run_tier_bench(trace_path: Optional[str] = None,
+                   limit: Optional[int] = None) -> Dict[str, Any]:
+    """The BENCH_TIER leg (ISSUE 16), three sub-legs over one replayed
+    multi-user trace:
+
+    1. **Capacity + quantization overhead**: int8 pages at the SAME
+       device byte budget as the fp pool — resident-sequence counts
+       from the honest ``bytes_per_page`` accounting (the >= 1.7x
+       check_bench gate) — and a measured fp-vs-int8 replay for the
+       TTFT p99 before/after comparison (the flat-within-15% gate).
+    2. **Host/disk tier**: a device-starved tiered engine replays the
+       trace twice; wave 2's per-request tier attribution is captured
+       into a private workload ledger and mined for the fleet-wide
+       prefix hit rate split by tier, plus promote-batch p50 ms.
+    3. **Cross-replica fetch**: a 2-replica pool serves the same
+       warm-prefix request once with page fetch on (affinity loses to
+       least-backlog, pages stream replica-to-replica) and once cold
+       with fetch off under an identical backlog shape — fetch TTFT
+       must beat recompute-prefill TTFT."""
+    import dataclasses as _dc
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.v2 import (FastGenScheduler,
+                                            SamplingParams,
+                                            ServingOptimizationConfig)
+    from deepspeed_tpu.inference.v2.lattice import load_trace_facts
+    from deepspeed_tpu.inference.v2.ragged.kv_cache import (
+        KVCacheConfig, pages_for_memory)
+    from deepspeed_tpu.serving import ReplicaPool
+    from deepspeed_tpu.telemetry import metrics as tm
+    from deepspeed_tpu.telemetry.workload_trace import get_workload_trace
+
+    if trace_path is None:
+        trace_path = os.environ.get(
+            "BENCH_TIER_TRACE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "traces", "sample_200.jsonl"))
+    if limit is None:
+        limit = int(os.environ.get("BENCH_TIER_LIMIT", "48"))
+    trace = load_trace(trace_path)
+    requests = [r for r in trace["requests"]
+                if r.get("outcome") == "ok"]
+    if limit:
+        requests = requests[:limit]
+    if not requests:
+        raise ValueError(f"{trace_path}: no replayable requests")
+    meta = trace["meta"]
+    cfg, params, page, need = _replay_model_parts(meta, requests)
+    per_seq = -(-need // page)
+    max_seqs = 32
+
+    # -- capacity at equal device bytes (the honest accounting the
+    # allocator itself sizes pools with — pages_for_memory).  The
+    # byte budget is deliberately CONSTRAINED (8 worst-case fp
+    # sequences for a 32-request wave): KV capacity, not FLOPs, is
+    # what caps concurrency, so the before/after TTFT comparison must
+    # run where that constraint binds — the fp pool queues on pages
+    # while the int8 pool holds ~3x the sequences in the same bytes
+    fp_pages = 8 * (per_seq + 1)
+    fp_kv = KVCacheConfig(num_layers=cfg.num_layers,
+                          kv_heads=cfg.kv_heads,
+                          head_dim=cfg.dims_per_head, page_size=page,
+                          num_pages=fp_pages, dtype=jnp.float32)
+    budget = fp_pages * fp_kv.bytes_per_page
+    q_pages = pages_for_memory(_dc.replace(fp_kv, quantization="int8"),
+                               budget)
+    out: Dict[str, Any] = {
+        "tier_requests": len(requests),
+        "tier_device_budget_mb": round(budget / 1e6, 2),
+        "tier_resident_seqs_fp": fp_pages // per_seq,
+        "tier_resident_seqs_int8": q_pages // per_seq,
+        "tier_resident_seq_ratio": round(
+            (q_pages // per_seq) / max(fp_pages // per_seq, 1), 3),
+    }
+
+    vocab = min(int(meta.get("vocab_size", 0)) or cfg.vocab_size,
+                cfg.vocab_size)
+    prompts = synthesize_prompts(requests, page, vocab)
+
+    # -- leg 1: fp baseline vs int8 at the same byte budget ----------
+    fp_eng = _build_engine(cfg, params, page, need, fp_pages, max_seqs)
+    replay(fp_eng, requests, prompts)            # shape warmup
+    _reset_engine(fp_eng)
+    before = replay(fp_eng, requests, prompts)
+    q_eng = _build_engine(
+        cfg, params, page, need, q_pages, max_seqs,
+        serving=ServingOptimizationConfig(kv_quantization="int8"))
+    replay(q_eng, requests, prompts)             # shape warmup
+    _reset_engine(q_eng)
+    after = replay(q_eng, requests, prompts)
+    out.update({
+        "tier_ttft_p99_before_ms": before["ttft_p99_ms"],
+        "tier_ttft_p99_after_ms": after["ttft_p99_ms"],
+        "tier_fp_decode_tok_s": before["decode_tok_s"],
+        "tier_int8_decode_tok_s": after["decode_tok_s"],
+        "tier_fp_compile_on_path": before["compile_on_path"],
+        "tier_int8_compile_on_path": after["compile_on_path"],
+        "tier_compile_on_path_total": (before["compile_on_path"]
+                                       + after["compile_on_path"]),
+    })
+
+    # -- leg 2: host/disk tier, warm wave mined from its own ledger --
+    tier_dir = tempfile.mkdtemp(prefix="ds_tier_bench_")
+    t_eng = None
+    try:
+        t_eng = build_tier_engine(meta, requests, device_pages=4,
+                                  host_pages=max(8, per_seq),
+                                  disk_pages=4096, tier_dir=tier_dir)
+        cold = replay(t_eng, requests, prompts)  # wave 1: demotes
+        # wave 2 is the WARM-shape warmup: promotion-warmed requests
+        # form mixed-kind step keys a cold wave never dispatches, so
+        # measuring wave 2 would eat their XLA compiles on-path.  The
+        # tier state cycles (promote -> park -> demote again), so wave
+        # 3 re-forms the same matched-page counts = the same keys.
+        replay(t_eng, requests, prompts)
+        # wave 3 measured, into a PRIVATE ledger: the per-request
+        # tier-hit attribution is then mined exactly the way
+        # tools/analyze_trace.py mines a production capture
+        ledger = os.path.join(tier_dir, "tier_warm_wave.jsonl")
+        wt = get_workload_trace()
+        wt.configure(ledger)
+        try:
+            warm = replay(t_eng, requests, prompts, capture=True)
+        finally:
+            wt.close()
+        stats = t_eng.state_manager.tiers.stats()
+        recs = load_trace_facts(ledger)["requests"]
+        prompt_tokens = sum(int(r["prompt_len"]) for r in recs) or 1
+        hits = {t: sum(int(r.get(f"hit_{t}", 0)) for r in recs)
+                for t in ("device", "host", "disk", "remote")}
+        out.update({
+            "tier_prefix_hit_rate": round(
+                sum(hits.values()) / prompt_tokens, 4),
+            "tier_device_hit_rate": round(
+                hits["device"] / prompt_tokens, 4),
+            "tier_host_hit_rate": round(
+                hits["host"] / prompt_tokens, 4),
+            "tier_disk_hit_rate": round(
+                hits["disk"] / prompt_tokens, 4),
+            "tier_remote_hit_rate": round(
+                hits["remote"] / prompt_tokens, 4),
+            "tier_demoted_pages": stats["demoted_pages"],
+            "tier_promoted_pages": stats["promoted_pages"],
+            "tier_spilled_pages": stats["spilled_pages"],
+            "tier_io_errors": stats["io_errors"],
+            "tier_cold_ttft_p99_ms": cold["ttft_p99_ms"],
+            "tier_warm_ttft_p99_ms": warm["ttft_p99_ms"],
+            "tier_promote_p50_ms": (
+                round(tm.KV_TIER_PROMOTE_MS.percentile(50), 3)
+                if tm.KV_TIER_PROMOTE_MS.count else None),
+            "tier_warm_compile_on_path": warm["compile_on_path"],
+        })
+        out["tier_compile_on_path_total"] += warm["compile_on_path"]
+    finally:
+        if t_eng is not None:
+            t_eng.state_manager.close()
+        shutil.rmtree(tier_dir, ignore_errors=True)
+
+    # -- leg 3: cross-replica page fetch vs recompute-prefill --------
+    # fetch exists to dodge LONG prefix recomputes, so the measured
+    # prefix is long (20 pages) — streaming 20 committed pages is a
+    # host-side copy, recomputing them is a full-width prefill
+    # dispatch.  Own model geometry: the trace-sized engines above
+    # cannot seat a 20-page prompt.
+    fetch_prefix_pages = 20
+    fetch_need = (fetch_prefix_pages + 2) * page + 16
+    fetch_fake = [{"prompt_len": fetch_need - page, "gen_len": 8}]
+    fcfg, fparams, _, _ = _replay_model_parts(meta, fetch_fake)
+    engines: Dict[str, Any] = {}
+
+    def factory(label):
+        eng = engines.get(label)
+        if eng is None:
+            eng = _build_engine(fcfg, fparams, page, fetch_need, 0, 8)
+            engines[label] = eng
+        return FastGenScheduler(eng)
+
+    def _p(seed_, n):
+        rng = np.random.default_rng(seed_)
+        return rng.integers(0, vocab, n,
+                            dtype=np.int64).astype(np.int32)
+
+    warm_prefix = _p(1, fetch_prefix_pages * page)
+    full = np.concatenate([warm_prefix, _p(2, page // 2)])
+    sp = SamplingParams(max_new_tokens=8, temperature=0.0)
+
+    def scenario(margin, warm):
+        """One placement scenario; both arms see the SAME backlog
+        shape (2 queued on r0, 1 on r1) so the measured request's
+        TTFT differs only by fetch-vs-recompute, not queue depth."""
+        for eng in engines.values():
+            for uid in list(eng.state_manager._seqs):
+                eng.flush(uid)
+            eng.reset_prefix_cache()
+        pool = ReplicaPool(factory, replicas=2,
+                           page_fetch_margin=margin)
+        if warm:
+            pool.submit(1, warm_prefix, sp)
+            pool.run_to_completion()
+            pool.publish_hints()
+        for uid, s in ((2, 7), (3, 8), (4, 9)):
+            pool.submit(uid, _p(s, 3 * page), sp)
+        pool.submit(100, full, sp)
+        pool.run_to_completion()
+        req = pool.request(100)
+        return ((req.first_token_mono - req.submit_mono) * 1e3,
+                req.replica)
+
+    # the warmup must include an actual FETCH: the import side's
+    # restore program is a compiled shape of its own, and eating that
+    # XLA compile inside the measured fetch TTFT would swamp the
+    # transfer-vs-recompute comparison
+    scenario(0, True)
+    scenario(-1, False)
+    f0, fp0 = tm.POOL_PAGE_FETCHES.value, tm.POOL_PAGE_FETCH_PAGES.value
+    # best-of-3 per arm: single-request TTFT on a shared CPU carries
+    # ms-scale scheduler jitter that would drown a transfer-vs-prefill
+    # delta measured once
+    fetch_ttft, fetch_rep = min(
+        scenario(0, True) for _ in range(3))
+    fetches = tm.POOL_PAGE_FETCHES.value - f0
+    recompute_ttft = min(
+        scenario(-1, False)[0] for _ in range(3))
+    out.update({
+        "tier_fetch_prefix_tokens": len(warm_prefix),
+        "tier_fetch_ttft_ms": round(fetch_ttft, 3),
+        "tier_recompute_ttft_ms": round(recompute_ttft, 3),
+        "tier_fetch_speedup_vs_recompute": (
+            round(recompute_ttft / fetch_ttft, 3) if fetch_ttft
+            else None),
+        "tier_fetch_count": fetches,
+        "tier_fetch_pages": tm.POOL_PAGE_FETCH_PAGES.value - fp0,
+        "tier_fetch_replica": fetch_rep,
+    })
+    return out
+
+
 # -- recorded-vs-replayed diff -----------------------------------------------
 def recorded_percentiles(requests: List[Dict[str, Any]]
                          ) -> Dict[str, Optional[float]]:
@@ -829,6 +1204,23 @@ def main(argv=None) -> int:
                     "page KV streaming handoff, keyed sampling on "
                     "both pools; --check additionally requires zero "
                     "lost requests")
+    ap.add_argument("--tier", action="store_true",
+                    help="replay twice on one device-starved engine "
+                    "backed by the host/disk prefix tier (ISSUE 16): "
+                    "wave 1 demotes every parked page, wave 2 must "
+                    "warm back through promotion; --check additionally "
+                    "requires demotions, disk spills, promotions, "
+                    "warm==cold tokens, and clean tier accounting")
+    ap.add_argument("--tier-device-pages", type=int, default=4,
+                    help="requested device pool size for --tier "
+                    "(clamped up to the smallest schedulable pool: "
+                    "one worst-case sequence + one page)")
+    ap.add_argument("--tier-host-pages", type=int, default=8,
+                    help="host DRAM ring capacity for --tier (kept "
+                    "tiny so the smoke also exercises disk spill)")
+    ap.add_argument("--tier-disk-pages", type=int, default=256,
+                    help="disk tier capacity for --tier (0 disables "
+                    "the disk tier and its spill check)")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the untimed shape-warmup pass (the "
                     "measured run then eats the XLA compiles)")
@@ -840,7 +1232,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        if args.disagg:
+        if args.tier:
+            out = run_tier_smoke(
+                args.trace, limit=args.limit,
+                include_errors=args.include_errors,
+                device_pages=args.tier_device_pages,
+                host_pages=args.tier_host_pages,
+                disk_pages=args.tier_disk_pages,
+                model_size=args.model_size, seed=args.seed,
+                tolerance=args.tolerance)
+        elif args.disagg:
             out = run_replay_disagg(
                 args.trace, limit=args.limit,
                 include_errors=args.include_errors,
