@@ -71,7 +71,8 @@ def _install_listener() -> None:
 
 def compile_config_digest(model_cfg: Any, kv_config: Any,
                           keyed_sampling: bool = False,
-                          lattice_digest: str = "") -> str:
+                          lattice_digest: str = "",
+                          draft_digest: str = "") -> str:
     """The (lattice + model-config + jaxlib) digest that namespaces one
     engine configuration's cache entries.  ``repr`` of the config
     dataclasses is stable across processes (no ids/addresses) and
@@ -86,6 +87,10 @@ def compile_config_digest(model_cfg: Any, kv_config: Any,
                str(getattr(kv_config, "quantization", "none"))],
         "keyed_sampling": bool(keyed_sampling),
         "lattice": str(lattice_digest),
+        # model-drafted spec (ISSUE 17): the draft trunk shapes the
+        # draft_spec/draft_fill programs — a draft-config change must
+        # be a cache miss, never a wrong executable ("" = draft off)
+        "draft": str(draft_digest),
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
     }

@@ -94,6 +94,22 @@ class ServingOptimizationConfig:
     #: (longer n-grams are tried first; raise to cut false drafts on
     #: low-repetition traffic)
     spec_ngram_min: int = 2
+    # -- model-drafted speculation (ISSUE 17) ---------------------------
+    #: which drafter proposes tokens: "ngram" (the model-free prompt-
+    #: lookup index, seed behavior), "model" (a same-family draft trunk
+    #: runs a device-resident draft loop inside the fused step — wins
+    #: on LOW-repetition traffic where n-gram is break-even), or
+    #: "auto" (per-request adaptive selection: an EWMA accept rate
+    #: switches each request ngram -> model -> off).  "model"/"auto"
+    #: build the draft trunk + a second paged KV pool at engine build
+    spec_drafter: str = "ngram"
+    #: draft trunk depth: the first N target layers (embed/final-norm/
+    #: lm-head always shared, so the draft adds NO new weights).  0 =
+    #: self-draft — the draft shares EVERY target layer; drafts are
+    #: near-exact, and the win is k+1 committed tokens per program
+    #: dispatch instead of one (the same dispatch-amortization as the
+    #: n-gram drafter, without needing repetitive output)
+    spec_draft_layers: int = 0
     # -- disaggregated prefill/decode serving (ISSUE 13) ----------------
     #: scheduler role: "both" (the fused single engine), "prefill"
     #: (prompt chunks + FIRST token only; finished requests park as

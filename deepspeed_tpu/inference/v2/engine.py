@@ -50,7 +50,9 @@ class SchedulingError(RuntimeError):
 #: key classes a role-shrunk lattice filters on (ISSUE 13): "prefill"
 #: = Q>1 logits/sample buckets (incl. fresh variants), "decode" = Q==1
 #: logits/sample buckets, "chain" = the double-buffer continuation
-#: family, "spec" = speculative verification buckets
+#: family, "spec" = the speculative families (verification buckets
+#: plus the ISSUE 17 model-drafted draft_spec/draft_fill programs —
+#: speculation is a decode-pool activity, so they class together)
 LATTICE_KINDS = ("prefill", "decode", "chain", "spec")
 
 
@@ -68,7 +70,7 @@ def lattice_kind_of(key: Tuple) -> str:
     kind = key[4] if len(key) > 4 else "logits"
     if kind == "chain":
         return "chain"
-    if kind == "spec":
+    if kind in ("spec", "draft_spec", "draft_fill"):
         return "spec"
     if kind == "mixed":
         # a mixed two-segment key carries a prefill segment — only a
@@ -83,7 +85,8 @@ def lattice_keys(max_prompt: int, max_new_tokens: int,
                  max_concurrency: int, page_size: int,
                  max_ragged_batch_size: int, has_fresh: bool,
                  sampling: bool, spec_max_draft: int = 0,
-                 kinds: Optional[Sequence[str]] = None) -> List[Tuple]:
+                 kinds: Optional[Sequence[str]] = None,
+                 draft: bool = False) -> List[Tuple]:
     """Every (S, Q, P[, fresh[, kind, ...]]) step-cache key the default
     power-of-two bucket lattice contains for this geometry — the ONE
     enumeration shared by ``InferenceEngineV2.precompile`` (which
@@ -135,7 +138,8 @@ def lattice_keys(max_prompt: int, max_new_tokens: int,
     keys = enumerate_lattice_keys(
         s_vals, q_vals, p_vals, page_size=page_size,
         max_ragged_batch_size=max_ragged_batch_size,
-        has_fresh=has_fresh, sampling=sampling, spec_q=spec_q)
+        has_fresh=has_fresh, sampling=sampling, spec_q=spec_q,
+        draft=draft)
     if kinds is not None:
         want = set(kinds)
         keys = [k for k in keys if lattice_kind_of(k) in want]
@@ -152,6 +156,26 @@ class InferenceEngineV2:
             # rewrites model.params in place (quantize_weights is
             # idempotent per format and refuses a format change)
             model.quantize_weights(self._config.quantization.fmt)
+        # model-drafted speculation (ISSUE 17): the draft trunk's facts
+        # are needed BEFORE KV sizing (the draft pool shares the memory
+        # budget) and before the compile-cache digest (the draft shapes
+        # the draft_spec/draft_fill programs)
+        sv0 = self._config.serving
+        drafter = getattr(sv0, "spec_drafter", "ngram") or "ngram"
+        if drafter not in ("ngram", "model", "auto"):
+            raise ValueError(
+                f"serving_optimization.spec_drafter={drafter!r} is not "
+                "a supported drafter — choose 'ngram' (prompt-lookup), "
+                "'model' (device-resident draft loop), or 'auto' "
+                "(per-request adaptive selection)")
+        self._draft_enabled = (bool(getattr(sv0, "speculative", False))
+                               and drafter in ("model", "auto"))
+        want_layers = int(getattr(sv0, "spec_draft_layers", 0) or 0)
+        n_layers = int(model.cfg.num_layers)
+        # 0 = self-draft: share EVERY target layer (pure dispatch
+        # amortization — the draft loop still needs its own KV pool)
+        self._draft_layers = (min(want_layers, n_layers) if want_layers > 0
+                              else n_layers) if self._draft_enabled else 0
         kv_user = self._config.kv_cache
         prev_quant = model.kv_config.quantization
         if not model.kv_config_explicit:
@@ -172,6 +196,13 @@ class InferenceEngineV2:
                 if budget is not None:
                     budget = int(
                         budget * self._config.state_manager.memory_fraction)
+                    if self._draft_enabled:
+                        # the draft pool is a parallel [L_draft, ...]
+                        # array over the SAME pages — shrink the target
+                        # budget so target + draft together fit the
+                        # fraction
+                        budget = int(budget * n_layers
+                                     / (n_layers + self._draft_layers))
                     kv_cfg = dataclasses.replace(
                         kv_cfg, num_pages=pages_for_memory(kv_cfg, budget))
                 else:
@@ -198,6 +229,11 @@ class InferenceEngineV2:
         # build, before any precompile/lattice work
         model.keyed_sampling = bool(
             getattr(self._config.serving, "keyed_sampling", False))
+        # draft trunk construction (ISSUE 17): like keyed_sampling, set
+        # on the model BEFORE any precompile — draft_cfg/draft_params
+        # shape the traced draft_spec/draft_fill signatures
+        if self._draft_enabled:
+            self._build_draft(model)
         # mined bucket lattice (ISSUE 14): "auto:<artifact-or-trace>"
         # resolves to non-power bucket tops + a precompile key set,
         # digest-validated against THIS engine's geometry (a mismatch
@@ -247,7 +283,8 @@ class InferenceEngineV2:
                 model.cfg, kv_cfg,
                 keyed_sampling=model.keyed_sampling,
                 lattice_digest=(self._lattice.digest
-                                if self._lattice is not None else ""))
+                                if self._lattice is not None else ""),
+                draft_digest=self.draft_digest)
             self._compile_cache_dir = enable_compile_cache(cache_dir,
                                                            digest)
         sv = self._config.serving
@@ -259,6 +296,28 @@ class InferenceEngineV2:
             tier_host_pages=int(getattr(sv, "kv_tier_host_pages", 0) or 0),
             tier_disk_pages=int(getattr(sv, "kv_tier_disk_pages", 0) or 0),
             tier_dir=getattr(sv, "kv_tier_dir", None))
+        # draft KV pool (ISSUE 17): a parallel plain-dtype page array
+        # addressed by the TARGET's page ids/page tables — allocation,
+        # commit and rollback all ride the existing allocator (the
+        # write-before-read overwrite rule needs no draft-side
+        # bookkeeping).  Always unquantized: it is its own pool with
+        # its own encoding, and the draft trunk reads it every
+        # iteration of the in-program draft loop.  Draft pages are
+        # never prefix-indexed (index_prefix only sees the target
+        # pool), so a shared prefix page can hold stale draft KV —
+        # that degrades accept rate until catch-up, never correctness.
+        self._draft_kv = None
+        self._draft_seen: Dict[int, int] = {}
+        if self._draft_enabled:
+            import jax.numpy as jnp
+            shape = (self._draft_layers, kv_cfg.num_pages + 1,
+                     kv_cfg.page_size, 2, kv_cfg.kv_heads,
+                     kv_cfg.head_dim)
+            dkv = jnp.zeros(shape, kv_cfg.dtype)
+            sharding = model.kv_sharding()
+            if sharding is not None:
+                dkv = jax.device_put(dkv, sharding)
+            self._draft_kv = dkv
         self._config.telemetry.apply()
         self._config.fault_injection.apply()
         self._bind_kv_gauges()
@@ -271,6 +330,65 @@ class InferenceEngineV2:
                         kv_pages=kv_cfg.num_pages,
                         page_size=kv_cfg.page_size)
         self._bind_digest_source()
+
+    def _build_draft(self, model: RaggedInferenceModel) -> None:
+        """Attach the draft trunk to the model: same family at
+        ``self._draft_layers`` layers, sharing the target's arrays —
+        the whole tree for self-draft, the leading layer slice (scan-
+        stacked) or per-layer references otherwise.  Embed, final norm
+        and lm head are ALWAYS the target's own."""
+        cfg = model.cfg
+        L, L_d = int(cfg.num_layers), self._draft_layers
+        model.draft_cfg = dataclasses.replace(cfg, num_layers=L_d)
+        if L_d == L:
+            model.draft_params = model.params
+            return
+        layers = model.params["layers"]
+        if isinstance(layers, dict) and "attn" in layers:   # scan-stacked
+            dlayers = jax.tree.map(lambda a: a[:L_d], layers)
+        else:                                               # per-layer
+            dlayers = {f"layer_{i}": layers[f"layer_{i}"]
+                       for i in range(L_d)}
+        model.draft_params = dict(model.params, layers=dlayers)
+
+    @property
+    def draft_enabled(self) -> bool:
+        """Model-drafted speculation is built into this engine
+        (``speculative`` on and ``spec_drafter`` is model/auto)."""
+        return self._draft_enabled
+
+    @property
+    def draft_digest(self) -> str:
+        """Identity of the draft trunk ("" = draft off): snapshot
+        bundles record it and ``restore()`` refuses a mismatch — a
+        draft-KV-free bundle restored under a DIFFERENT draft config
+        would silently change which programs serve the workload."""
+        if not self._draft_enabled:
+            return ""
+        import hashlib
+        facts = f"{self._draft_layers}:{self._model.draft_cfg!r}"
+        return hashlib.blake2b(facts.encode("utf-8"),
+                               digest_size=8).hexdigest()
+
+    def draft_lag(self, uid: int) -> int:
+        """Committed tokens the draft pool has NOT covered for ``uid``
+        (prompt prefill, non-spec commits, prefix hits and restores all
+        advance the target without touching the draft pool).  The
+        scheduler dispatches a draft_fill catch-up while this is > 0."""
+        sd = self._state.get_sequence(uid)
+        if sd is None:
+            return 0
+        return max(sd.seen_tokens - self._draft_seen.get(uid, 0), 0)
+
+    def mark_draft_seen(self, uids: Sequence[int]) -> None:
+        """Record that the draft pool now covers each uid's committed
+        history — called after :meth:`commit_spec` of a draft_spec
+        dispatch (the in-program draft loop wrote KV for every
+        committed position, including the full-accept case)."""
+        for uid in uids:
+            sd = self._state.get_sequence(uid)
+            if sd is not None:
+                self._draft_seen[uid] = sd.seen_tokens
 
     def _bind_digest_source(self) -> None:
         """Publish this engine's prefix-cache affinity hints on the
@@ -357,7 +475,6 @@ class InferenceEngineV2:
         (the whole point of a role-restricted pool is compiling fewer
         programs).  Returns the compiled keys."""
         sm = self._config.state_manager
-        kv = self._state.kv_cache.data
         if spec_max_draft is None:
             sv = self._config.serving
             spec_max_draft = (int(getattr(sv, "spec_max_draft", 0) or 0)
@@ -377,7 +494,9 @@ class InferenceEngineV2:
                 max_ragged_batch_size=sm.max_ragged_batch_size,
                 has_fresh=getattr(self._model, "_fresh_attention",
                                   None) is not None,
-                sampling=sampling, spec_max_draft=spec_max_draft)
+                sampling=sampling, spec_max_draft=spec_max_draft,
+                draft=(self._draft_enabled and sampling
+                       and spec_max_draft > 0))
             keys = lattice_keys(kinds=kinds, **kwargs)
             if kinds is not None:
                 full = len(lattice_keys(**kwargs))
@@ -390,10 +509,30 @@ class InferenceEngineV2:
                         "pools' programs defeats disaggregation's "
                         "compile-time win)")
         for key in keys:
-            self._model.precompile_step(key, kv)
+            self._model.precompile_step(key, self._kv_aval_for(key))
         if strict:
             self._model.strict_shapes = True
         return keys
+
+    def _kv_aval_for(self, key: Tuple):
+        """The KV argument one step-cache key's program takes: the
+        target pool, the draft pool (draft_fill), or the donated
+        (target, draft) pair (draft_spec)."""
+        kind = key[4] if len(key) > 4 else "logits"
+        kv = self._state.kv_cache.data
+        if kind == "draft_spec":
+            if self._draft_kv is None:
+                raise ValueError(
+                    f"step key {key} needs the draft pool but this "
+                    "engine was built without spec_drafter=model/auto")
+            return (kv, self._draft_kv)
+        if kind == "draft_fill":
+            if self._draft_kv is None:
+                raise ValueError(
+                    f"step key {key} needs the draft pool but this "
+                    "engine was built without spec_drafter=model/auto")
+            return self._draft_kv
+        return kv
 
     def _auto_lattice_keys(self, sampling: bool, spec_max_draft: int,
                            kinds: Optional[Sequence[str]],
@@ -426,6 +565,15 @@ class InferenceEngineV2:
                 # draft depth the trace ran with
                 if key[1] != lat.bucket_q(1 + spec_max_draft):
                     continue
+            if kind in ("draft_spec", "draft_fill"):
+                # artifact mined on a model-drafted engine serving an
+                # engine without the draft trunk (or with speculation
+                # off): the draft programs can't trace — drop them
+                if not (self._draft_enabled and spec_max_draft > 0):
+                    continue
+                if (kind == "draft_spec"
+                        and key[1] != lat.bucket_q(1 + spec_max_draft)):
+                    continue
             if not has_fresh and (bool(key[3]) or (
                     kind == "mixed" and bool(key[8]))):
                 continue    # fresh variants normalize to False anyway
@@ -452,7 +600,9 @@ class InferenceEngineV2:
             # a lattice mined from a spec-free trace still serves an
             # engine with speculation on: generate the spec family
             # over its own tops (same inclusion rules the shared
-            # enumeration applies)
+            # enumeration applies); a draft-capable engine additionally
+            # gets the draft_spec twins and the draft_fill catch-up
+            # family (one per logits-geometry bucket)
             spec_q = lat.bucket_q(1 + spec_max_draft)
             page = self._model.kv_config.page_size
             have = set(keys)
@@ -463,9 +613,25 @@ class InferenceEngineV2:
                     if P * page < spec_q:
                         continue
                     for greedy in (True, False):
-                        key = (S, spec_q, P, False, "spec", greedy)
-                        if key not in have:
-                            keys.append(key)
+                        for kk in (("spec", greedy),) + (
+                                (("draft_spec", greedy),)
+                                if self._draft_enabled else ()):
+                            key = (S, spec_q, P, False) + kk
+                            if key not in have:
+                                keys.append(key)
+                                have.add(key)
+            if self._draft_enabled:
+                for S in lat.s_tops:
+                    for Q in lat.q_tops:
+                        if S * Q > sm.max_ragged_batch_size:
+                            continue
+                        for P in lat.p_tops:
+                            if P * page < Q:
+                                continue
+                            key = (S, Q, P, False, "draft_fill")
+                            if key not in have:
+                                keys.append(key)
+                                have.add(key)
         if kinds is not None:
             _validate_kinds(kinds)
             want = set(kinds)
@@ -509,12 +675,11 @@ class InferenceEngineV2:
         lists accepted).  Unknown/uncompilable keys warn and are
         skipped — a manifest from a slightly different build must never
         block a restore.  Returns the number of keys now compiled."""
-        kv = self._state.kv_cache.data
         done = 0
         for k in keys:
             key = tuple(k)
             try:
-                self._model.precompile_step(key, kv)
+                self._model.precompile_step(key, self._kv_aval_for(key))
                 done += 1
             except Exception as e:  # noqa: BLE001 — per-key isolation
                 from ...utils.logging import logger
@@ -745,7 +910,9 @@ class InferenceEngineV2:
             S = _bucket(len(batch_uids), MIN_SLOTS)
             Q = _bucket(max(max(len(t) for t in batch_tokens), min_q))
             P = _bucket(max(pages), MIN_PAGES)
-        fresh = (all_new and Q > 1 and not suffix[:1] == ("spec",)
+        fresh = (all_new and Q > 1
+                 and suffix[:1] not in (("spec",), ("draft_spec",),
+                                        ("draft_fill",))
                  and getattr(model, "_fresh_attention", None) is not None)
         return (S, Q, P, fresh) + suffix
 
@@ -920,6 +1087,94 @@ class InferenceEngineV2:
             top_ps, greedy_only, row_uids=kuids, row_pos=kpos)
         return out
 
+    def step_draft_spec(self, batch_uids: Sequence[int],
+                        batch_tokens: Sequence[np.ndarray],
+                        row_params: Sequence, rng: jax.Array,
+                        min_q: int = 1,
+                        row_pos: Optional[Sequence[int]] = None
+                        ) -> jax.Array:
+        """Model-drafted speculative step (ISSUE 17): like
+        :meth:`step_spec`, but the host only knows each row's LAST
+        COMMITTED token — ``batch_tokens[i] = [last, 0...0]`` with
+        ``len == 1 + room`` (room = drafts this row may commit), and
+        the draft trunk proposes the rest inside the compiled program.
+        Returns a device [S, 2+k] int32 array: accepted count,
+        corrected token, then the k drafted tokens (the host slices the
+        first ``accepted`` to reconstruct the committed block).  The
+        commit is deferred to :meth:`commit_spec` exactly like the
+        n-gram path; call :meth:`mark_draft_seen` after it so lag
+        tracking knows the draft pool kept up."""
+        descs = self._admit_batch(batch_uids, batch_tokens,
+                                  do_checks=False)
+        batch = self._build_batch(
+            descs, [np.asarray(t) for t in batch_tokens], min_q=min_q)
+        temps, top_ks, top_ps = self._pad_sample_params(
+            row_params, batch.num_slots)
+        kuids, kpos = self._pad_keyed(batch_uids, row_pos,
+                                      batch.num_slots)
+        greedy_only = not bool((temps > 0.0).any())
+        serving_counters.record_program(
+            h2d_bytes=temps.nbytes + top_ks.nbytes + top_ps.nbytes)
+        out, (self._state.kv_cache.data, self._draft_kv) = \
+            self._model.draft_spec_step(
+                batch, (self._state.kv_cache.data, self._draft_kv),
+                rng, temps, top_ks, top_ps, greedy_only,
+                row_uids=kuids, row_pos=kpos)
+        return out
+
+    def step_draft_fill(self, batch_uids: Sequence[int],
+                        batch_tokens: Sequence[np.ndarray]) -> None:
+        """Draft-KV catch-up (ISSUE 17): write the DRAFT pool's KV for
+        already-committed history the host still knows —
+        ``batch_tokens[i]`` is the slice
+        ``history[draft_seen : draft_seen + chunk]`` for uid i.  The
+        target pool, seen counts and the allocator are untouched (this
+        must NOT ride ``_admit_batch``: the tokens are committed, not
+        new), pages are the sequence's existing table, and NOTHING
+        crosses d2h.  Advances the engine's per-uid draft-seen mark."""
+        from .ragged.batch import MIN_PAGES, MIN_SLOTS, _bucket
+        from .ragged import RaggedBatch
+        page = self._model.kv_config.page_size
+        sds, starts, caps = [], [], []
+        for uid in batch_uids:
+            sd = self._state.get_sequence(uid)
+            if sd is None:
+                raise ValueError(
+                    f"step_draft_fill: unknown sequence uid {uid}")
+            sds.append(sd)
+            starts.append(self._draft_seen.get(uid, 0))
+            caps.append(max(sd.allocated_capacity, 1))
+        lengths = [len(t) for t in batch_tokens]
+        if self._lattice is not None:
+            S = self._lattice.bucket_s(len(batch_uids))
+            Q = self._lattice.bucket_q(max(lengths))
+            P = self._lattice.bucket_p(max(caps))
+        else:
+            S = _bucket(len(batch_uids), MIN_SLOTS)
+            Q = _bucket(max(lengths))
+            P = _bucket(max(caps), MIN_PAGES)
+        token_ids = np.zeros((S, Q), np.int32)
+        q_lens = np.zeros(S, np.int32)
+        start_pos = np.zeros(S, np.int32)
+        page_table = np.zeros((S, P), np.int32)
+        for i, (sd, toks, start) in enumerate(
+                zip(sds, batch_tokens, starts)):
+            toks = np.asarray(toks, np.int32).reshape(-1)
+            token_ids[i, :len(toks)] = toks
+            q_lens[i] = len(toks)
+            start_pos[i] = start
+            page_table[i] = sd.page_table(P)
+        batch = RaggedBatch(token_ids=token_ids, q_lens=q_lens,
+                            start_pos=start_pos, page_table=page_table,
+                            uids=list(batch_uids), fresh=False)
+        serving_counters.record_program(
+            h2d_bytes=token_ids.nbytes + q_lens.nbytes
+            + start_pos.nbytes + page_table.nbytes)
+        self._draft_kv = self._model.draft_fill_step(batch,
+                                                     self._draft_kv)
+        for uid, start, n in zip(batch_uids, starts, lengths):
+            self._draft_seen[uid] = start + n
+
     # dslint: hot-path
     def commit_spec(self, batch_uids: Sequence[int],
                     committed: Sequence[int]) -> None:
@@ -1002,6 +1257,7 @@ class InferenceEngineV2:
 
     def flush(self, uid: int) -> None:
         self._state.flush_sequence(uid)
+        self._draft_seen.pop(uid, None)
 
     def offload_sequence(self, uid: int) -> None:
         """Preempt a sequence: its KV moves to host and the pages return

@@ -126,8 +126,8 @@ def _pick(n: int, tops: Tuple[int, ...], floor: int) -> int:
 def enumerate_lattice_keys(s_vals: Sequence[int], q_vals: Sequence[int],
                            p_vals: Sequence[int], *, page_size: int,
                            max_ragged_batch_size: int, has_fresh: bool,
-                           sampling: bool, spec_q: int = 0
-                           ) -> List[Tuple]:
+                           sampling: bool, spec_q: int = 0,
+                           draft: bool = False) -> List[Tuple]:
     """Every (S, Q, P[, fresh[, kind, ...]]) step-cache key the bucket
     lattice over the given dimension tops contains — the ONE
     enumeration behind both the power-of-two default
@@ -135,7 +135,12 @@ def enumerate_lattice_keys(s_vals: Sequence[int], q_vals: Sequence[int],
     a mined :class:`BucketLattice` (arbitrary tops), so the two can
     never drift on the key-family rules (fresh variants, chain
     cross-products, the spec bucket).  ``spec_q`` is the
-    ALREADY-BUCKETED speculative Q width (0 = no spec keys)."""
+    ALREADY-BUCKETED speculative Q width (0 = no spec keys).
+    ``draft`` adds the model-drafted families (ISSUE 17): a
+    "draft_spec" twin of every spec key (the device-resident draft
+    loop + verify program) and a "draft_fill" twin of every plain
+    logits key (the draft-KV catch-up forward — it chunk-buckets
+    exactly like prefill, so it rides the same (S, Q, P) grid)."""
     s_vals = sorted({int(s) for s in s_vals})
     q_vals = sorted({int(q) for q in q_vals} | {1})
     p_vals = sorted({int(p) for p in p_vals})
@@ -156,6 +161,9 @@ def enumerate_lattice_keys(s_vals: Sequence[int], q_vals: Sequence[int],
                               else (False,)):
                     key = (S, Q, P, fresh)
                     keys.append(key)
+                    if draft and not fresh:
+                        # catch-up writes paged draft KV — never fresh
+                        keys.append((S, Q, P, False, "draft_fill"))
                     if not sampling:
                         continue
                     for greedy in (True, False):
@@ -179,6 +187,9 @@ def enumerate_lattice_keys(s_vals: Sequence[int], q_vals: Sequence[int],
                     continue
                 for greedy in (True, False):
                     keys.append((S, spec_q, P, False, "spec", greedy))
+                    if draft:
+                        keys.append((S, spec_q, P, False, "draft_spec",
+                                     greedy))
     return keys
 
 
@@ -281,6 +292,7 @@ def mine_lattice(trace: Dict[str, Any], ratio: float = 1.3,
     s_set, p_set, q_obs, spec_draft = set(), set(), set(), 0
     mixed_combos = set()
     fresh_seen = False
+    draft_seen = False
     for k in occ:
         s_set.add(int(k[0]))
         p_set.add(int(k[2]))
@@ -289,8 +301,12 @@ def mine_lattice(trace: Dict[str, Any], ratio: float = 1.3,
         kind = k[4] if len(k) > 4 else "logits"
         if kind == "chain":
             s_set.add(int(k[5]))
-        elif kind == "spec":
+        elif kind in ("spec", "draft_spec"):
             spec_draft = max(spec_draft, int(k[1]) - 1)
+            draft_seen = draft_seen or kind == "draft_spec"
+        elif kind == "draft_fill":
+            q_obs.add(int(k[1]))
+            draft_seen = True
         elif kind == "mixed":
             # (S_d, 1, P_d, False, "mixed", S_p, Q_p, P_p, fresh_p, g)
             s_set.add(int(k[5]))
@@ -341,7 +357,8 @@ def mine_lattice(trace: Dict[str, Any], ratio: float = 1.3,
     keys = enumerate_lattice_keys(
         lat.s_tops, lat.q_tops, lat.p_tops, page_size=page,
         max_ragged_batch_size=max_ragged_batch_size,
-        has_fresh=fresh_seen, sampling=True, spec_q=spec_q)
+        has_fresh=fresh_seen, sampling=True, spec_q=spec_q,
+        draft=draft_seen)
     # mixed expansion: fitted Q tops re-bucket prompt chunks, so each
     # observed mixed combination fans out across every fitted Q_p the
     # replayed chunking could now form
@@ -437,7 +454,7 @@ def _validate_artifact(doc: Any, path: str) -> Dict[str, Any]:
     # per-kind key arity: a truncated/hand-edited key would otherwise
     # surface as a raw IndexError deep inside engine precompile
     kind_len = {"logits": 4, "sample": 6, "chain": 7, "spec": 6,
-                "mixed": 10}
+                "draft_spec": 6, "draft_fill": 5, "mixed": 10}
     for i, key in enumerate(doc["keys"]):
         n = len(key) if isinstance(key, (list, tuple)) else 0
         kind = key[4] if n > 4 else ("logits" if n == 4 else None)

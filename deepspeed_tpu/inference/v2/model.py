@@ -207,6 +207,18 @@ class RaggedInferenceModel:
         #: power-of-two default.  Engine-build-time, like
         #: ``keyed_sampling``: it shapes the compiled program set.
         self.lattice = None
+        #: model-drafted speculation (ISSUE 17): the draft trunk's
+        #: config + param tree, set by the engine BEFORE any precompile
+        #: (like ``keyed_sampling`` — they shape the traced "draft_spec"
+        #: / "draft_fill" program signatures).  The draft is the SAME
+        #: family at fewer layers (``spec_draft_layers``; 0 = the
+        #: self-draft degenerate case sharing every target layer), so
+        #: ``draft_params`` shares the target's arrays — embed, final
+        #: norm and lm head are always shared, layer trees are slices
+        #: (scan-stacked) or per-layer references.  None/None = no
+        #: draft model built.
+        self.draft_cfg = None
+        self.draft_params = None
         # -- per-program cost accounting (ISSUE 9): flops/bytes from
         # compiled.cost_analysis() per step-cache key, accumulated per
         # dispatch so serving throughput gets a hardware denominator
@@ -379,6 +391,48 @@ class RaggedInferenceModel:
                     jnp.asarray(top_ks, jnp.int32),
                     jnp.asarray(top_ps, jnp.float32),
                     *self._keyed_args(row_uids, row_pos))
+
+    def draft_spec_step(self, batch: RaggedBatch, kv_pair, rng: jax.Array,
+                        temps, top_ks, top_ps, greedy_only: bool,
+                        row_uids=None, row_pos=None):
+        """Model-drafted speculative step (ISSUE 17): the DRAFT trunk
+        autoregressively proposes up to k = Q-1 tokens inside the
+        compiled program (``lax.scan`` over Q draft iterations, each a
+        Q=1 paged forward against the draft KV pool), and the proposals
+        feed straight into the target's ``_spec_step_impl``
+        verification — draft tokens never cross d2h mid-step.  The host
+        only supplies ``token_ids[:, 0]`` (the last committed token per
+        row); the rest of the row is ignored.  ``kv_pair`` is the
+        (target_kv, draft_kv) tuple — donated together.  Returns
+        ([S, 2+k] int32, (target_kv, draft_kv)): accepted count,
+        corrected token, then the k drafted tokens the host has never
+        seen (it slices the first ``accepted`` of them to reconstruct
+        the committed block)."""
+        key = self._normalize_key(batch.shape_key)[:3] + (
+            False, "draft_spec", bool(greedy_only))
+        step = self._get_step(key)
+        return step({"target": self.params, "draft": self.draft_params},
+                    kv_pair, batch.token_ids, batch.q_lens,
+                    batch.start_pos, batch.page_table, rng,
+                    jnp.asarray(temps, jnp.float32),
+                    jnp.asarray(top_ks, jnp.int32),
+                    jnp.asarray(top_ps, jnp.float32),
+                    *self._keyed_args(row_uids, row_pos))
+
+    def draft_fill_step(self, batch: RaggedBatch, draft_kv):
+        """Catch the draft KV pool up over ALREADY-COMMITTED history
+        (prompt prefill, non-spec decode commits, prefix-cache hits and
+        snapshot restores all advance the target without touching the
+        draft pool): one draft-trunk-only forward that writes draft KV
+        for the batch's positions and returns the new pool — nothing
+        crosses d2h.  Correctness never depends on this running (the
+        verify step gates every commit); it only restores the draft's
+        context so its proposals are worth accepting."""
+        key = self._normalize_key(batch.shape_key)[:3] + (
+            False, "draft_fill")
+        step = self._get_step(key)
+        return step(self.draft_params, draft_kv, batch.token_ids,
+                    batch.q_lens, batch.start_pos, batch.page_table)
 
     def chained_step(self, batch: RaggedBatch, kv: jax.Array,
                      prev_tokens: jax.Array, gather_idx, rng: jax.Array,
@@ -567,6 +621,11 @@ class RaggedInferenceModel:
         if kind == "spec":
             return functools.partial(self._spec_step_impl,
                                      greedy_only=key[5])
+        if kind == "draft_spec":
+            return functools.partial(self._draft_spec_step_impl,
+                                     greedy_only=key[5])
+        if kind == "draft_fill":
+            return self._draft_fill_step_impl
         if kind == "mixed":
             # key = (S_d, 1, P_d, False, "mixed",
             #        S_p, Q, P_p, fresh_p, greedy_only)
@@ -596,6 +655,14 @@ class RaggedInferenceModel:
             return [self.params, kv_aval] + batch_avals
         if kind in ("sample", "spec"):
             return [self.params, kv_aval] + batch_avals + sample_avals(S)
+        if kind == "draft_spec":
+            # kv_aval is the (target_kv, draft_kv) pair the engine hands
+            # precompile for draft keys; params is the matching pair
+            pair = {"target": self.params, "draft": self.draft_params}
+            return [pair, kv_aval] + batch_avals + sample_avals(S)
+        if kind == "draft_fill":
+            # draft-trunk only: draft params + draft kv, no sampling
+            return [self.draft_params, kv_aval] + batch_avals
         if kind == "mixed":
             S_p, Q_p, P_p = key[5:8]
             pre_avals = [sds((S_p, Q_p), i32), sds((S_p,), i32),
@@ -630,12 +697,15 @@ class RaggedInferenceModel:
                 else params["lm_head"].astype(cfg.dtype))
 
     def _forward_hidden(self, params, kv, token_ids, q_lens, start_pos,
-                        page_table, fresh: bool = False):
+                        page_table, fresh: bool = False, cfg=None):
         """The shared trunk of every step kind: embed -> layers -> final
         norm.  Returns (x [S, Q, E], new kv) — the step kinds differ
         only in which positions they unembed (last-token gather for the
-        logits/sample kinds, EVERY position for the spec verify)."""
-        cfg = self.cfg
+        logits/sample kinds, EVERY position for the spec verify).
+        ``cfg`` overrides the trunk geometry (the model-drafted spec
+        path runs the DRAFT trunk — same family, fewer layers — through
+        the same embed/norm/attention modules); None = the target."""
+        cfg = cfg if cfg is not None else self.cfg
         S, Q = token_ids.shape
         x = self._embed(params["embed"]["tokens"].astype(cfg.dtype),
                         token_ids)
@@ -650,7 +720,7 @@ class RaggedInferenceModel:
 
         body = functools.partial(self._layer_body, pos=pos, sin=sin, cos=cos,
                                  q_lens=q_lens, start_pos=start_pos,
-                                 page_table=page_table, fresh=fresh)
+                                 page_table=page_table, fresh=fresh, cfg=cfg)
         if cfg.scan_layers:
             x, kv = jax.lax.scan(
                 lambda carry, xs: (body(carry, xs[0], xs[1])),
@@ -775,6 +845,77 @@ class RaggedInferenceModel:
         return jnp.stack([accepts, corrected], axis=1), kv   # [S, 2]
 
     # dslint: hot-path
+    def _draft_spec_step_impl(self, params, kv, token_ids, q_lens,
+                              start_pos, page_table, rng, temps, top_ks,
+                              top_ps, row_uids=None, row_pos=None,
+                              greedy_only: bool = False):
+        """Device-resident model-drafted speculation (ISSUE 17 tentpole):
+        ``params = {"target", "draft"}``, ``kv = (target_kv, draft_kv)``
+        (donated as one tuple).  The draft loop runs Q iterations of a
+        Q=1 draft-trunk forward under ``lax.scan``: iteration j feeds
+        the previous emission (iteration 0 feeds ``token_ids[:, 0]``,
+        the last committed token) at position ``start_pos + j`` with a
+        per-iteration q-len mask ``j < q_lens`` — so a row with
+        q_lens = 1+r writes draft KV for ALL r+1 of its input positions
+        (the full-accept case leaves the draft pool contiguous through
+        the last committed token; rejected positions are overwritten
+        write-before-read next step, the same discipline as the target
+        pool).  Drafts are always the draft trunk's greedy argmax —
+        they are proposals; the VERIFY reduction's emitted tokens
+        (target argmax, or keyed/stochastic draws) alone decide what
+        commits, which is what makes greedy model-drafted spec
+        bit-equal to spec-off and keyed sampling schedule-invariant.
+        Returns ([S, 2+k] int32, (target_kv, draft_kv)) with k = Q-1:
+        accepted count, corrected token, then the k drafted tokens."""
+        target_kv, draft_kv = kv
+        dcfg = self.draft_cfg
+        dparams = params["draft"]
+        S, Q = token_ids.shape
+        lm_head = self._lm_head(dparams)
+        bias = (dparams["lm_head_bias"].astype(self.cfg.dtype)
+                if "lm_head_bias" in dparams else None)
+
+        def draft_iter(carry, j):
+            dkv, tok = carry
+            qj = jnp.where(j < q_lens, 1, 0).astype(jnp.int32)
+            x, dkv = self._forward_hidden(
+                dparams, dkv, tok[:, None], qj, start_pos + j,
+                page_table, fresh=False, cfg=dcfg)
+            logits = jnp.einsum("se,ev->sv", x[:, 0, :], lm_head)
+            if bias is not None:
+                logits = logits + bias
+            nxt = jnp.argmax(logits.astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            return (dkv, nxt), nxt
+
+        (draft_kv, _), emitted = jax.lax.scan(
+            draft_iter, (draft_kv, token_ids[:, 0]),
+            jnp.arange(Q, dtype=jnp.int32))
+        # emitted[j] is d_{j+1}; the verify row is [t0, d_1..d_{Q-1}]
+        # (iteration Q-1's emission only exists to write d_{Q-1}'s
+        # draft KV for the full-accept case — it is discarded)
+        tok_mat = jnp.concatenate(
+            [token_ids[:, :1], jnp.transpose(emitted[:Q - 1])], axis=1)
+        out, target_kv = self._spec_step_impl(
+            params["target"], target_kv, tok_mat, q_lens, start_pos,
+            page_table, rng, temps, top_ks, top_ps, row_uids, row_pos,
+            greedy_only=greedy_only)
+        return (jnp.concatenate([out, tok_mat[:, 1:]], axis=1),
+                (target_kv, draft_kv))
+
+    # dslint: hot-path
+    def _draft_fill_step_impl(self, params, kv, token_ids, q_lens,
+                              start_pos, page_table):
+        """Draft-trunk-only forward that writes draft KV for the
+        batch's positions (``params`` = draft params, ``kv`` = the
+        draft pool, donated).  No unembed consumer, no output but the
+        pool — the catch-up path moves ZERO bytes device->host."""
+        _, kv = self._forward_hidden(params, kv, token_ids, q_lens,
+                                     start_pos, page_table, fresh=False,
+                                     cfg=self.draft_cfg)
+        return kv
+
+    # dslint: hot-path
     def _mixed_sample_step_impl(self, params, kv, d_tok, d_ql, d_sp,
                                 d_pt, p_tok, p_ql, p_sp, p_pt, rng,
                                 temps, top_ks, top_ps,
@@ -809,8 +950,8 @@ class RaggedInferenceModel:
         return tokens, kv
 
     def _layer_body(self, x, lp, kv_layer, *, pos, sin, cos, q_lens,
-                    start_pos, page_table, fresh: bool = False):
-        cfg = self.cfg
+                    start_pos, page_table, fresh: bool = False, cfg=None):
+        cfg = cfg if cfg is not None else self.cfg
         dtype = cfg.dtype
         h = self._norm(lp["norm1"], x)
         ap = lp["attn"]

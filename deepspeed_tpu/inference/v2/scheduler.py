@@ -33,6 +33,18 @@ commit 0..Q tokens per row (``engine.commit_spec`` variable advance,
 stop tokens truncate inside accepted blocks).  ``on_token`` is the
 complete per-token delivery; the ``step()`` dict keeps one (the last)
 token per uid.
+
+Model-drafted speculation (ISSUE 17, ``spec_drafter="model"|"auto"``):
+a device-resident draft trunk autoregresses the drafts INSIDE the
+fused step (``_dispatch_draft_spec``, ``[S, 2+k]`` transfer), so the
+host never proposes and low-repetition traffic speculates too.  Each
+request carries its own adaptive drafter state: a per-drafter accept
+EWMA plus a dry-spell backoff, and under ``"auto"`` the scheduler
+switches a request ngram -> model -> off as its workload phase
+demands (``spec.drafter_switch`` flight events).  The draft trunk's
+KV trails the target's by construction after restore/handoff/plain
+decode runs; ``_dispatch_draft_fill`` catches it up in token-less
+steps before model drafting resumes.
 """
 
 from __future__ import annotations
@@ -104,6 +116,21 @@ class Request:
     #: ledger records both so the analyzer can recommend spec_max_draft
     spec_drafted: int = 0
     spec_accepted: int = 0
+    #: adaptive drafter state (ISSUE 17) — PER REQUEST, because accept
+    #: rate is a property of each request's traffic, not the fleet's:
+    #: dry-spell streak + backoff window (the ISSUE 10 globals, moved
+    #: here), the active drafter ("" = unresolved; resolved lazily from
+    #: config on first spec attempt), per-drafter accept EWMA
+    #: ({"ngram","model"} -> rate, -1.0 = untried), and per-drafter
+    #: drafted/accepted splits of the ISSUE 10 totals above
+    spec_dry: int = 0
+    spec_cool: int = 0
+    spec_drafter: str = ""
+    spec_ewma: Optional[Dict[str, float]] = None
+    spec_drafted_ngram: int = 0
+    spec_accepted_ngram: int = 0
+    spec_drafted_model: int = 0
+    spec_accepted_model: int = 0
     #: warm-prefix provenance (ISSUE 16): tokens attached at admission
     #: per tier ({"device","host","disk","remote"} -> tokens), captured
     #: at the one-shot prefix lookup (the sequence may be flushed
@@ -300,14 +327,19 @@ class FastGenScheduler:
         self._drafter = (NgramDrafter(
             max(int(getattr(sv, "spec_ngram_min", 2) or 1), 1))
             if self._spec_cfg and self._spec_max_draft else None)
-        #: consecutive fruitless spec attempts (nothing drafted or
-        #: nothing accepted) and the backoff window they open — while
-        #: cooling down, the scheduler keeps the normal (chain-capable)
-        #: path so a draft-less workload keeps the async double-buffer
-        #: overlap (a spec attempt must drain the in-flight step first:
-        #: the host drafter needs the committed tokens)
-        self._spec_dry = 0
-        self._spec_cooldown = 0
+        # -- model-drafted speculation (ISSUE 17) ---------------------
+        #: configured drafter policy: "ngram" (ISSUE 10 host drafting
+        #: only), "model" (device draft trunk forced), "auto" (per-
+        #: request state machine ngram -> model -> off)
+        self._spec_drafter_cfg = str(
+            getattr(sv, "spec_drafter", "ngram") or "ngram")
+        #: the engine actually built a draft trunk + draft KV pool —
+        #: the capability gate for "model"/"auto" (an engine built
+        #: without one silently serves the ngram path: policy follows
+        #: the scheduler's serving view, capability follows the engine)
+        self._draft_ok = bool(self._spec_cfg and self._spec_max_draft
+                              and getattr(engine, "draft_enabled",
+                                          False))
         #: strict-shapes latches (the `_fused_ready` pattern): a strict
         #: engine either has spec buckets compiled (positive latch) or
         #: never will (negative latch + one warning)
@@ -316,6 +348,9 @@ class FastGenScheduler:
         #: cumulative drafted/accepted behind ds_fastgen_spec_accept_rate
         self._spec_drafted_cum = 0
         self._spec_accepted_cum = 0
+        #: model-drafter split behind ds_fastgen_spec_draft_accept_rate
+        self._spec_draft_drafted_cum = 0
+        self._spec_draft_accepted_cum = 0
         self._snapshot_grace_s = float(
             getattr(sv, "snapshot_grace_s", 5.0) or 0.0)
         self._snapshot_path = str(getattr(sv, "snapshot_path", "") or "")
@@ -384,6 +419,11 @@ class FastGenScheduler:
                            if req.first_sched_mono else None),
             spec_drafted=req.spec_drafted,
             spec_accepted=req.spec_accepted,
+            spec_drafter=req.spec_drafter,
+            spec_ngram=[req.spec_drafted_ngram,
+                        req.spec_accepted_ngram],
+            spec_model=[req.spec_drafted_model,
+                        req.spec_accepted_model],
             hit_device=(req.tier_hits or {}).get("device", 0),
             hit_host=(req.tier_hits or {}).get("host", 0),
             hit_disk=(req.tier_hits or {}).get("disk", 0),
@@ -765,8 +805,9 @@ class FastGenScheduler:
         actual token count fits the budget — exactly the superbuckets
         the precompile lattice skips — so membership, not arithmetic, is
         the gate.  ``suffix`` is () for a logits key,
-        ("sample", greedy_only), or ("spec", greedy_only) with
-        ``min_q`` the spec Q-bucket floor."""
+        ("sample", greedy_only), ("spec", greedy_only) /
+        ("draft_spec", greedy_only) with ``min_q`` the spec Q-bucket
+        floor, or ("draft_fill",) for the draft catch-up program."""
         model = self._engine.model
         if not getattr(model, "strict_shapes", False):
             return True
@@ -774,11 +815,18 @@ class FastGenScheduler:
                                             min_q=min_q)
         return key in model._step_cache
 
-    # -- speculative decoding (ISSUE 10) -------------------------------------
+    # -- speculative decoding (ISSUE 10 / ISSUE 17) --------------------------
     #: dry-spell backoff ceiling: after N consecutive fruitless
-    #: attempts (nothing drafted, or nothing accepted) speculation is
-    #: re-attempted at most every N+1 steps
+    #: attempts (nothing drafted, or nothing accepted) a request's
+    #: speculation is re-attempted at most every N+1 steps
     _SPEC_BACKOFF_MAX = 8
+    #: per-drafter accept-rate EWMA smoothing (ISSUE 17)
+    _SPEC_EWMA_ALPHA = 0.3
+    #: "auto" switches a request off its current drafter when the
+    #: drafter's EWMA sits below this after >= _SPEC_MIN_TRIES drafted
+    #: tokens (or after that many consecutive dry attempts)
+    _SPEC_SWITCH_BELOW = 0.25
+    _SPEC_MIN_TRIES = 4
 
     @property
     def _spec_on(self) -> bool:
@@ -797,7 +845,8 @@ class FastGenScheduler:
             return True
         if self._warned_strict_spec:
             return False    # negative latch: don't rescan the cache
-        if any(len(k) > 4 and k[4] == "spec" for k in model._step_cache):
+        if any(len(k) > 4 and k[4] in ("spec", "draft_spec")
+               for k in model._step_cache):
             self._spec_strict_ready = True
             return True
         from ...utils.logging import logger
@@ -813,48 +862,197 @@ class FastGenScheduler:
     def _spec_gate(self) -> bool:
         """Preconditions for attempting a speculative step: pure
         steady-state decode (the chained path's membership conditions)
-        and not inside a dry-spell cooldown.  An attempt costs the
-        async overlap (the in-flight step must drain before the host
-        drafter can see committed tokens), and a zero-accept dispatch
-        costs a Q-wide verify for one token — so fruitless attempts
-        back off linearly (capped) instead of retrying every step, and
-        an accepted draft resets the backoff."""
+        and at least one request outside its dry-spell cooldown with a
+        live drafter.  An attempt costs the async overlap (the
+        in-flight step must drain before the host drafter can see
+        committed tokens), and a zero-accept dispatch costs a Q-wide
+        verify for one token — so each request's fruitless attempts
+        back off linearly (capped), and an accepted draft resets its
+        backoff.  Cooldowns tick here (once per step)."""
         if not self._spec_on or self._pending or self._preempted \
                 or not self._running:
             return False
         if any(r.prefill_remaining > 0 for r in self._running.values()):
             return False
-        if self._spec_cooldown > 0:
-            self._spec_cooldown -= 1
-            return False
-        return True
+        eligible = False
+        for req in self._running.values():
+            if req.spec_cool > 0:
+                req.spec_cool -= 1
+                continue
+            if self._drafter_of(req) != "off":
+                eligible = True
+        return eligible
 
-    def _spec_fruitless(self) -> None:
-        self._spec_dry += 1
-        self._spec_cooldown = min(self._spec_dry, self._SPEC_BACKOFF_MAX)
+    # -- adaptive drafter selection (ISSUE 17) -------------------------------
+    def _drafter_of(self, req: Request) -> str:
+        """Resolve (lazily initializing) the request's active drafter:
+        "ngram", "model", or "off".  Config "ngram"/"model" pins the
+        answer (capability-gated: a forced "model" on an engine with no
+        draft trunk serves ngram); "auto" starts every request on the
+        free host drafter and lets :meth:`_maybe_switch_drafter` move
+        it.  An "off" request whose backoff expired re-probes its
+        historically-best drafter — workloads have phases, and a
+        request parked off during a stochastic burst must get another
+        chance once its traffic turns draftable."""
+        if not req.spec_drafter:
+            mode = self._spec_drafter_cfg
+            if mode in ("model", "auto") and not self._draft_ok:
+                mode = "ngram"
+            req.spec_drafter = "ngram" if mode == "auto" else mode
+            req.spec_ewma = {"ngram": -1.0, "model": -1.0}
+        if (req.spec_drafter == "off" and req.spec_cool == 0
+                and self._spec_drafter_cfg == "auto"):
+            ew = req.spec_ewma or {}
+            cands = ("ngram", "model") if self._draft_ok else ("ngram",)
+            self._switch_drafter(
+                req, max(cands, key=lambda k: ew.get(k, -1.0)))
+        return req.spec_drafter
+
+    def _switch_drafter(self, req: Request, new: str) -> None:
+        old, req.spec_drafter = req.spec_drafter, new
+        if new == "off":
+            # parked: the re-probe in _drafter_of fires when this
+            # window expires, so "off" is periodic, not permanent
+            req.spec_dry = req.spec_cool = self._SPEC_BACKOFF_MAX
+        else:
+            req.spec_dry = req.spec_cool = 0
+        ew = req.spec_ewma or {}
+        get_flight_recorder().record(
+            "spec.drafter_switch", uid=req.uid, src=old, dst=new,
+            ewma_ngram=round(ew.get("ngram", -1.0), 3),
+            ewma_model=round(ew.get("model", -1.0), 3))
+
+    def _maybe_switch_drafter(self, req: Request) -> None:
+        """The "auto" state machine: ngram -> model when the free host
+        drafter demonstrably isn't paying (low EWMA over enough tries,
+        or a pure dry spell — low-repetition traffic never even
+        proposes), model -> off when the draft trunk isn't either
+        (truncated-trunk drafts on hard traffic).  Forced configs never
+        switch."""
+        if self._spec_drafter_cfg != "auto":
+            return
+        ew = req.spec_ewma or {}
+
+        def bad(name: str, tried: int) -> bool:
+            return ((tried >= self._SPEC_MIN_TRIES
+                     and 0.0 <= ew.get(name, -1.0)
+                     < self._SPEC_SWITCH_BELOW)
+                    or req.spec_dry >= self._SPEC_MIN_TRIES)
+
+        if req.spec_drafter == "ngram" and self._draft_ok \
+                and bad("ngram", req.spec_drafted_ngram):
+            self._switch_drafter(req, "model")
+        elif req.spec_drafter == "model" \
+                and bad("model", req.spec_drafted_model):
+            self._switch_drafter(req, "off")
+
+    def _note_spec_dry(self, req: Request) -> None:
+        """One fruitless attempt (nothing proposed / nothing accepted):
+        extend the request's backoff and let "auto" react."""
+        req.spec_dry += 1
+        req.spec_cool = min(req.spec_dry, self._SPEC_BACKOFF_MAX)
+        self._maybe_switch_drafter(req)
+
+    def _note_spec_result(self, req: Request, drafter: str,
+                          drafted: int, accepted: int) -> None:
+        """Account one verified draft block against ``drafter``: the
+        ISSUE 10 totals, the per-drafter split the ledger records, the
+        accept EWMA, and the backoff (reset on any acceptance)."""
+        req.spec_drafted += drafted
+        req.spec_accepted += accepted
+        if drafter == "model":
+            req.spec_drafted_model += drafted
+            req.spec_accepted_model += accepted
+        else:
+            req.spec_drafted_ngram += drafted
+            req.spec_accepted_ngram += accepted
+        if accepted:
+            req.spec_dry = req.spec_cool = 0
+        else:
+            req.spec_dry += 1
+            req.spec_cool = min(req.spec_dry, self._SPEC_BACKOFF_MAX)
+        if drafted:
+            if req.spec_ewma is None:
+                req.spec_ewma = {"ngram": -1.0, "model": -1.0}
+            rate = accepted / drafted
+            prev = req.spec_ewma.get(drafter, -1.0)
+            req.spec_ewma[drafter] = (
+                rate if prev < 0.0
+                else (1.0 - self._SPEC_EWMA_ALPHA) * prev
+                + self._SPEC_EWMA_ALPHA * rate)
+        self._maybe_switch_drafter(req)
 
     def _plan_spec(self):
-        """Draft + admission plan for one speculative step: every
+        """Drafter-mode resolution + draft/admission plan for one
+        speculative step.  One step runs ONE mode — host n-gram drafts
+        and device model drafts can't mix in one program — so any
+        eligible model-selecting row pulls the step into model mode
+        (cooling / differently-selected rows ride as plain q_len=1
+        rows).  Returns ``(mode, rows)`` with mode "ngram"/"model" and
+        rows ``[(uid, req, tokens, draft), ...]``, or ``("fill",
+        rows)`` when model mode must first catch the draft trunk's KV
+        up (``[(uid, tokens), ...]`` token-less plan), or None when
+        nothing drafted / budget refused / strict-uncovered — callers
+        fall back to the normal paths.  Must run AFTER the in-flight
+        step drained (the drafter reads committed tokens)."""
+        mode = "ngram"
+        for req in self._running.values():
+            if req.spec_cool == 0 and self._drafter_of(req) == "model":
+                mode = "model"
+                break
+        if mode == "model":
+            # the draft trunk's KV must cover every row's committed
+            # history before the device draft loop can extend it — ANY
+            # lagging row (restored, handed off, or admitted during an
+            # ngram phase) holds the whole step back since all rows
+            # ride the one program
+            lagged = [(u, r) for u, r in self._running.items()
+                      if self._engine.draft_lag(u) > 0]
+            if lagged:
+                fill = self._plan_draft_fill(lagged)
+                if fill is not None:
+                    return ("fill", fill)
+                mode = "ngram"  # fill bucket never covered: host path
+            if mode == "model":
+                plan = self._plan_spec_mode("model")
+                if plan is not None:
+                    return ("model", plan)
+                mode = "ngram"  # draft_spec uncovered / budget refused
+        plan = self._plan_spec_mode(mode)
+        return (mode, plan) if plan is not None else None
+
+    def _plan_spec_mode(self, mode: str):
+        """Row plan for one speculative step in ``mode``: every
         running row gets ``[last_committed, draft...]`` tokens (draft
         possibly empty — rows verify raggedly within the one spec
-        bucket).  Returns ``[(uid, req, tokens, draft), ...]`` or None
-        when nothing drafted / budget refused / strict-uncovered —
-        callers fall back to the normal paths.  Must run AFTER the
-        in-flight step drained (the drafter reads committed tokens)."""
+        bucket).  In model mode the draft is placeholder zeros (the
+        device drafts in-program; the length shapes the row)."""
         adm = _Admission(self._engine, self._budget)
         max_seq = int(getattr(self._engine.model.cfg, "max_seq_len",
                               1 << 30))
         rows = []
         any_draft = False
         for uid, req in self._running.items():
+            drafts_here = (req.spec_cool == 0
+                           and self._drafter_of(req) == mode)
             # room for the mandatory 1 corrected/bonus token + drafts:
             # never draft past max_new_tokens or the model context
             room = min(self._spec_max_draft,
                        req.params.max_new_tokens - len(req.generated) - 1,
-                       max_seq - self._engine.seen_tokens(uid) - 2)
-            draft = (self._drafter.propose(uid, req.prompt,
-                                           req.generated, room)
-                     if room > 0 else np.zeros(0, np.int32))
+                       max_seq - self._engine.seen_tokens(uid) - 2) \
+                if drafts_here else 0
+            if room > 0 and mode == "model":
+                draft = np.zeros(room, np.int32)    # device-drafted
+            elif room > 0:
+                draft = self._drafter.propose(uid, req.prompt,
+                                              req.generated, room)
+                if not len(draft):
+                    # attempted and found nothing: this request's
+                    # backoff extends even if the step proceeds on
+                    # other rows' drafts
+                    self._note_spec_dry(req)
+            else:
+                draft = np.zeros(0, np.int32)
             last = (req.generated[-1] if req.generated
                     else int(req.prompt[-1]))
             toks = np.concatenate(
@@ -873,12 +1071,46 @@ class FastGenScheduler:
             return None
         greedy_only = all(req.params.temperature <= 0.0
                           for _, req, _, _ in rows)
+        suffix = (("draft_spec", greedy_only) if mode == "model"
+                  else ("spec", greedy_only))
         if not self._strict_key_ok(
                 [u for u, _, _, _ in rows],
-                [t for _, _, t, _ in rows], ("spec", greedy_only),
+                [t for _, _, t, _ in rows], suffix,
                 min_q=1 + self._spec_max_draft):
             return None
         return rows
+
+    def _plan_draft_fill(self, lagged):
+        """Catch-up plan: feed each lagging row's already-committed
+        history slice (``draft_seen .. seen_tokens``) through the draft
+        trunk so its KV reaches the target's frontier.  Chunked to the
+        step token budget (a huge restored backlog fills over several
+        steps); under strict shapes the chunk cap halves until a
+        compiled ``draft_fill`` bucket covers the batch, or None when
+        even the Q=1 bucket isn't there (callers then serve ngram)."""
+        budget = self._budget
+        rows = []
+        for uid, req in lagged:
+            lag = self._engine.draft_lag(uid)
+            seen = self._engine.seen_tokens(uid)
+            hist = np.concatenate(
+                [np.asarray(req.prompt, np.int32),
+                 np.asarray(req.generated, np.int32)])[:seen]
+            chunk = min(lag, max(budget, 1))
+            rows.append((uid, hist[seen - lag: seen - lag + chunk]))
+            budget -= chunk
+            if budget <= 0:
+                break               # the rest fills next step
+        while rows:
+            if self._strict_key_ok([u for u, _ in rows],
+                                   [t for _, t in rows],
+                                   ("draft_fill",)):
+                return rows
+            cap = max(len(t) for _, t in rows) // 2
+            if cap < 1:
+                return None
+            rows = [(u, t[:cap]) for u, t in rows]
+        return None
 
     # dslint: hot-path
     def _dispatch_spec(self, rows, on_token) -> Dict[int, int]:
@@ -928,16 +1160,13 @@ class FastGenScheduler:
             # the a drafts plus the correction)
             drafted += len(draft)
             accepted += min(a, c)
-            req.spec_drafted += len(draft)
-            req.spec_accepted += min(a, c)
+            if len(draft):
+                self._note_spec_result(req, "ngram", len(draft),
+                                       min(a, c))
         self._engine.commit_spec(uids, committed)
         for uid, req, _t, _d in rows:
             if req.done:
                 self._finish_request(req)
-        if accepted:
-            self._spec_dry = self._spec_cooldown = 0
-        else:
-            self._spec_fruitless()
         self._spec_drafted_cum += drafted
         self._spec_accepted_cum += accepted
         tm.FASTGEN_SPEC_DRAFTED.inc(drafted)
@@ -946,6 +1175,91 @@ class FastGenScheduler:
             tm.FASTGEN_SPEC_ACCEPT_RATE.set(
                 self._spec_accepted_cum / self._spec_drafted_cum)
         return out
+
+    # dslint: hot-path
+    def _dispatch_draft_spec(self, rows, on_token) -> Dict[int, int]:
+        """Model-drafted sibling of :meth:`_dispatch_spec` (ISSUE 17):
+        ONE fused program runs the draft trunk's k-token greedy loop
+        AND the target's ragged verification, returning [S, 2+k] int32
+        (accepted count, corrected token, the k device-drafted tokens)
+        per row — still the step's only d2h.  The host never proposed
+        anything, so it reconstructs each committed block from the
+        RETURNED drafts; everything downstream (variable-advance
+        commit, stop-token truncation, accept accounting) matches the
+        n-gram path, plus ``mark_draft_seen`` records that the draft
+        trunk's KV now covers every committed position."""
+        uids = [u for u, _, _, _ in rows]
+        toks = [t for _, _, t, _ in rows]
+        params = [req.params for _, req, _, _ in rows]
+        greedy_only = all(p.temperature <= 0.0 for p in params)
+        # keyed: position j of a spec row emits generation index
+        # len(generated) + j (the device folds per position)
+        row_pos = ([len(req.generated) for _, req, _, _ in rows]
+                   if self._keyed else None)
+        with trace_span("fastgen.dispatch.draft_spec"):
+            out_dev = self._engine.step_draft_spec(
+                uids, toks, params, self._next_key(greedy_only),
+                min_q=1 + self._spec_max_draft, row_pos=row_pos)
+        self.last_step_scheduled = len(uids)
+        av = np.asarray(out_dev)            # dslint: d2h [S, 2+k] int32
+        serving_counters.record_d2h(av.nbytes)
+        out: Dict[int, int] = {}
+        committed: List[int] = []
+        drafted = accepted = 0
+        for i, (uid, req, _t, draft) in enumerate(rows):
+            room = len(draft)
+            a = min(int(av[i, 0]), room)
+            block = [int(t) for t in av[i, 2:2 + a]] + [int(av[i, 1])]
+            c = 0
+            for tok in block:
+                c += 1
+                if self._deliver_token(req, tok, out, on_token):
+                    # termination deferred: flush needs the descriptor
+                    # the variable-advance commit below still updates
+                    req.done = True
+                    break
+            committed.append(c)
+            drafted += room
+            accepted += min(a, c)
+            if room:
+                self._note_spec_result(req, "model", room, min(a, c))
+        self._engine.commit_spec(uids, committed)
+        self._engine.mark_draft_seen(uids)
+        for uid, req, _t, _d in rows:
+            if req.done:
+                self._finish_request(req)
+        self._spec_drafted_cum += drafted
+        self._spec_accepted_cum += accepted
+        self._spec_draft_drafted_cum += drafted
+        self._spec_draft_accepted_cum += accepted
+        tm.FASTGEN_SPEC_DRAFTED.inc(drafted)
+        tm.FASTGEN_SPEC_ACCEPTED.inc(accepted)
+        tm.FASTGEN_SPEC_DRAFT_DRAFTED.inc(drafted)
+        tm.FASTGEN_SPEC_DRAFT_ACCEPTED.inc(accepted)
+        if self._spec_drafted_cum:
+            tm.FASTGEN_SPEC_ACCEPT_RATE.set(
+                self._spec_accepted_cum / self._spec_drafted_cum)
+        if self._spec_draft_drafted_cum:
+            tm.FASTGEN_SPEC_DRAFT_ACCEPT_RATE.set(
+                self._spec_draft_accepted_cum
+                / self._spec_draft_drafted_cum)
+        return out
+
+    def _dispatch_draft_fill(self, rows) -> None:
+        """Token-less draft-trunk catch-up step: run the committed
+        history chunks through the draft trunk's forward so its KV
+        reaches the target's frontier.  Nothing commits, nothing
+        samples, nothing crosses device->host — the step exists purely
+        so the NEXT step's draft loop has valid draft KV to attend
+        over."""
+        uids = [u for u, _ in rows]
+        with trace_span("fastgen.dispatch.draft_fill"):
+            self._engine.step_draft_fill(uids, [t for _, t in rows])
+        self.last_step_scheduled = len(uids)
+        n = int(sum(len(t) for _, t in rows))
+        tm.FASTGEN_SPEC_DRAFT_FILL.inc(n)
+        get_flight_recorder().record("spec.draft_fill",
+                                     rows=len(uids), tokens=n)
 
     # -- one engine step -----------------------------------------------------
     def step(self, on_token: Optional[Callable[[int, int], None]] = None
@@ -1052,22 +1366,30 @@ class FastGenScheduler:
         spec_drained: Optional[Dict[int, int]] = None
         if self._spec_gate():
             # speculation needs the committed token stream on the host
-            # (the drafter's n-gram key ends at the LAST token), so the
+            # (the drafter's n-gram key ends at the LAST token; the
+            # draft trunk's catch-up reads committed history), so the
             # in-flight chained step drains first; if nothing drafts,
             # fall through to the normal admission path with the drain
             # already done (the chain plan needs an in-flight step)
             spec_drained = self._drain(on_token)
-            rows = self._plan_spec()
-            if rows is not None:
+            plan = self._plan_spec()
+            if plan is not None:
+                mode, rows = plan
+                if mode == "fill":
+                    # token-less draft-KV catch-up: model drafting
+                    # resumes once the trunk reaches the frontier
+                    self._dispatch_draft_fill(rows)
+                    return spec_drained
                 try:
-                    out = self._dispatch_spec(rows, on_token)
+                    out = (self._dispatch_draft_spec(rows, on_token)
+                           if mode == "model"
+                           else self._dispatch_spec(rows, on_token))
                 except KVAllocationError as e:
                     self._degrade_oom(e, [], [])
                     return spec_drained
                 self._oom_streak = 0
                 spec_drained.update(out)
                 return spec_drained
-            self._spec_fruitless()
 
         chain = self._plan_chain() if spec_drained is None else None
         if chain is not None:
@@ -1600,7 +1922,23 @@ class FastGenScheduler:
                 # (spec steps drain in-step, so a snapshot never holds
                 # undrained speculative state — committed tokens only)
                 "spec_drafted": int(req.spec_drafted),
-                "spec_accepted": int(req.spec_accepted)}
+                "spec_accepted": int(req.spec_accepted),
+                # adaptive drafter state (ISSUE 17 bugfix): the
+                # backoff/EWMA machine must survive a migration — a
+                # restored request used to restart as a fresh probe
+                # (drafter re-resolved from config, dry spell
+                # forgotten), re-paying the whole exploration it
+                # already did on the source replica
+                "spec_state": {
+                    "drafter": req.spec_drafter,
+                    "dry": int(req.spec_dry),
+                    "cool": int(req.spec_cool),
+                    "ewma": {k: float(v) for k, v
+                             in (req.spec_ewma or {}).items()},
+                    "ngram": [int(req.spec_drafted_ngram),
+                              int(req.spec_accepted_ngram)],
+                    "model": [int(req.spec_drafted_model),
+                              int(req.spec_accepted_model)]}}
 
     def _restore_request(self, d: dict, now: float) -> Request:
         pr = d["params"]
@@ -1621,6 +1959,20 @@ class FastGenScheduler:
         req.submit_mono = now
         req.spec_drafted = int(d.get("spec_drafted", 0))
         req.spec_accepted = int(d.get("spec_accepted", 0))
+        ss = d.get("spec_state")
+        if ss:
+            # legacy bundles (no spec_state) keep the old behavior:
+            # the drafter re-resolves lazily from config
+            req.spec_drafter = str(ss.get("drafter", "") or "")
+            req.spec_dry = int(ss.get("dry", 0))
+            req.spec_cool = int(ss.get("cool", 0))
+            ew = ss.get("ewma") or {}
+            req.spec_ewma = ({str(k): float(v) for k, v in ew.items()}
+                             if ew else None)
+            req.spec_drafted_ngram, req.spec_accepted_ngram = (
+                int(x) for x in ss.get("ngram", (0, 0)))
+            req.spec_drafted_model, req.spec_accepted_model = (
+                int(x) for x in ss.get("model", (0, 0)))
         ttl = d.get("ttl_remaining_s")
         if ttl is not None:
             req.deadline = now + float(ttl)
@@ -1688,6 +2040,15 @@ class FastGenScheduler:
                         self._engine._lattice.digest
                         if self._engine._lattice is not None else ""),
                 },
+                # model-drafted spec (ISSUE 17): draft KV deliberately
+                # does NOT ride the bundle (catch-up refills it — the
+                # drafts never change token values, only commit
+                # grouping), but the DRAFTER itself must match at
+                # restore: per-request EWMA/backoff state restored
+                # against a different draft trunk would be
+                # systematically wrong signals
+                "draft_digest": getattr(self._engine, "draft_digest",
+                                        ""),
             }
             if path is not None:
                 write_bundle(path, meta, arrays)
@@ -1729,6 +2090,18 @@ class FastGenScheduler:
                 raise SnapshotError(
                     "restore requires a fresh scheduler (this one has "
                     "queued work or is closed)")
+            want = meta.get("draft_digest")
+            if want is not None:
+                # legacy bundles (field absent) restore as before; a
+                # PRESENT digest must match — the restored adaptive
+                # drafter state is calibrated against that draft trunk
+                have = str(getattr(self._engine, "draft_digest", ""))
+                if str(want) != have:
+                    raise SnapshotError(
+                        f"snapshot was taken with draft trunk "
+                        f"{str(want)!r} but this engine runs {have!r} "
+                        "— restore onto an engine with the same "
+                        "spec_drafter/spec_draft_layers configuration")
             self._engine.state_manager.import_state(meta["engine"],
                                                     arrays)
             # warm birth (ISSUE 14): precompile the bundle's

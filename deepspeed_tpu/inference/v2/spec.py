@@ -1,12 +1,32 @@
-"""Model-free speculative drafting (ISSUE 10): prompt-lookup n-grams.
+"""Speculative drafters (ISSUE 10 n-grams, ISSUE 17 draft model).
 
-The drafter proposes the next few tokens of a decode row by looking the
-row's trailing n-gram up in its OWN history (prompt + committed tokens)
-and copying what followed the previous occurrence — "prompt lookup
-decoding": no draft model, no extra device memory, no new weights.  The
-fused serving step then verifies all drafts in ONE dispatch through the
+A drafter proposes the next few tokens of a decode row; the fused
+serving step then verifies all drafts in ONE dispatch through the
 ragged Q>1 kernel path and the scheduler commits the accepted prefix at
-drain (scheduler.py `_dispatch_spec`).
+drain (scheduler.py `_dispatch_spec` / `_dispatch_draft_spec`).
+
+Drafter protocol (duck-typed, what the scheduler relies on):
+
+- ``propose(uid, prompt, generated, max_draft) -> np.ndarray`` — up to
+  ``max_draft`` int32 draft tokens continuing ``prompt + generated``
+  (possibly empty: "nothing to propose this step").
+- ``drop(uid)`` — release any per-request state on termination.
+- ``__len__`` — live per-request state count (leak tests).
+
+Two implementations:
+
+- :class:`NgramDrafter` — host-side prompt-lookup decoding: look the
+  row's trailing n-gram up in its OWN history and copy what followed
+  the previous occurrence.  No draft model, no extra device memory, no
+  new weights.  The drafter proposes CONCRETE tokens on the host, so
+  the scheduler ships ``[last, draft...]`` and the device only
+  verifies.
+- :class:`ModelDrafter` — device-resident draft model (ISSUE 17): the
+  drafting loop runs INSIDE the fused step (``model.draft_spec_step``),
+  so ``propose`` returns placeholders and the real draft tokens come
+  back with the verification verdict in the ``[S, 2+k]`` transfer.
+  The class exists to make the seam explicit and to carry the
+  host-side bookkeeping mirror of the device drafter.
 
 Why this drafter: serving traffic is dominated by extraction,
 summarization, code edit and chat-with-context workloads where the
@@ -156,3 +176,38 @@ class NgramDrafter:
 
     def __len__(self) -> int:
         return len(self._seqs)
+
+
+class ModelDrafter:
+    """Device-resident draft-model drafter (ISSUE 17).
+
+    The actual drafting runs on device inside the fused
+    ``draft_spec`` program: a truncated-trunk (or shared-trunk) draft
+    model autoregresses ``k`` greedy tokens against its own KV pool and
+    the target verifies them in the same dispatch — no host round-trip
+    between drafting and verification, which is the whole point (the
+    n-gram drafter's propose/verify split costs the async overlap every
+    attempted step).
+
+    ``propose`` therefore returns PLACEHOLDER zeros sized to the
+    requested draft length: the scheduler uses the length to shape the
+    ragged row (``[last, 0*k]``) and reads the real draft tokens from
+    the program's ``[S, 2+k]`` return.  Host state is nothing but the
+    uid set (symmetry with :class:`NgramDrafter` for leak accounting).
+    """
+
+    def __init__(self) -> None:
+        self._live: Dict[int, bool] = {}
+
+    def propose(self, uid: int, prompt: np.ndarray,
+                generated: List[int], max_draft: int) -> np.ndarray:
+        if max_draft <= 0:
+            return np.zeros(0, dtype=np.int32)
+        self._live[uid] = True
+        return np.zeros(max_draft, dtype=np.int32)
+
+    def drop(self, uid: int) -> None:
+        self._live.pop(uid, None)
+
+    def __len__(self) -> int:
+        return len(self._live)

@@ -489,6 +489,14 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
     spec_max_draft: int = 3
     #: shortest trailing n-gram the prompt-lookup drafter matches on
     spec_ngram_min: int = 2
+    # -- model-drafted speculation (ISSUE 17) --------------------------
+    #: drafter: "ngram" (prompt lookup, seed), "model" (same-family
+    #: draft trunk, device-resident draft loop in the fused step), or
+    #: "auto" (per-request EWMA accept rate switches ngram->model->off)
+    spec_drafter: str = "ngram"
+    #: draft trunk depth — first N target layers, weights shared; 0 =
+    #: self-draft (every layer shared; pure dispatch amortization)
+    spec_draft_layers: int = 0
     # -- disaggregated prefill/decode serving (ISSUE 13) ---------------
     #: scheduler role: "both" | "prefill" | "decode" — prefill-only
     #: engines run prompt chunks + the first token and park requests
@@ -537,6 +545,8 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
                 "speculative": self.speculative,
                 "spec_max_draft": self.spec_max_draft,
                 "spec_ngram_min": self.spec_ngram_min,
+                "spec_drafter": self.spec_drafter,
+                "spec_draft_layers": self.spec_draft_layers,
                 "role": self.role,
                 "keyed_sampling": self.keyed_sampling,
                 "compile_cache_dir": self.compile_cache_dir,

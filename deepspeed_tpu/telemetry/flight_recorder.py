@@ -72,6 +72,7 @@ EVENT_KINDS = frozenset({
     "request.preempt", "request.restore",
     "selfheal.retry", "selfheal.rollback",
     "slo.advice", "slo.verdict",
+    "spec.draft_fill", "spec.drafter_switch",
     "watchdog.anomaly", "watchdog.compile_on_path",
     "watchdog.nonfinite", "watchdog.overflow_skip",
 })

@@ -232,6 +232,23 @@ FASTGEN_SPEC_ACCEPT_RATE = registry.gauge(
     "ds_fastgen_spec_accept_rate",
     "cumulative accepted/drafted ratio of speculative decoding")
 
+# -- model-drafted speculation (ISSUE 17) ------------------------------------
+FASTGEN_SPEC_DRAFT_DRAFTED = registry.counter(
+    "ds_fastgen_spec_draft_drafted_total",
+    "draft tokens produced by the device-resident draft trunk inside "
+    "fused draft_spec steps")
+FASTGEN_SPEC_DRAFT_ACCEPTED = registry.counter(
+    "ds_fastgen_spec_draft_accepted_total",
+    "model-drafted tokens accepted by on-device verification and "
+    "committed")
+FASTGEN_SPEC_DRAFT_ACCEPT_RATE = registry.gauge(
+    "ds_fastgen_spec_draft_accept_rate",
+    "cumulative accepted/drafted ratio of the model drafter alone")
+FASTGEN_SPEC_DRAFT_FILL = registry.counter(
+    "ds_fastgen_spec_draft_fill_tokens_total",
+    "committed-history tokens replayed through the draft trunk in "
+    "token-less catch-up steps (restore/handoff/ngram-phase lag)")
+
 # -- fleet observatory (ISSUE 11) --------------------------------------------
 FASTGEN_TOKENS = registry.counter(
     "ds_fastgen_tokens_total",

@@ -181,6 +181,9 @@ class WorkloadTrace:
                        queue_wait_ms: Optional[float],
                        spec_drafted: int = 0,
                        spec_accepted: int = 0,
+                       spec_drafter: str = "",
+                       spec_ngram: Optional[List[int]] = None,
+                       spec_model: Optional[List[int]] = None,
                        hit_device: int = 0,
                        hit_host: int = 0,
                        hit_disk: int = 0,
@@ -190,6 +193,13 @@ class WorkloadTrace:
         never token ids.  ``spec_drafted``/``spec_accepted`` are this
         request's speculative-decoding facts (ISSUE 10): the analyzer
         mines accept rates from them to recommend ``spec_max_draft``.
+        ``spec_drafter`` is the request's final drafter selection
+        (ISSUE 17: "ngram"/"model"/"off"; "" = speculation never ran)
+        and ``spec_ngram``/``spec_model`` the per-drafter
+        (drafted, accepted) splits of the totals, written out as the
+        four scalar ``spec_<drafter>_drafted``/``_accepted`` fields —
+        the analyzer mines per-drafter accept rates from them to
+        recommend spec_drafter.
         ``hit_device``/``hit_host``/``hit_disk``/``hit_remote`` are the
         request's warm-prefix tokens by tier of origin (ISSUE 16) — the
         analyzer's tier-hit report sizes the host/disk tiers from
@@ -214,6 +224,13 @@ class WorkloadTrace:
                               else round(queue_wait_ms, 3)),
             "spec_drafted": int(spec_drafted),
             "spec_accepted": int(spec_accepted),
+            "spec_drafter": str(spec_drafter),
+            # flattened to scalars: digests are the ONLY list-shaped
+            # field a request record may carry (content-free audit)
+            "spec_ngram_drafted": int((spec_ngram or (0, 0))[0]),
+            "spec_ngram_accepted": int((spec_ngram or (0, 0))[1]),
+            "spec_model_drafted": int((spec_model or (0, 0))[0]),
+            "spec_model_accepted": int((spec_model or (0, 0))[1]),
             "hit_device": int(hit_device),
             "hit_host": int(hit_host),
             "hit_disk": int(hit_disk),

@@ -120,6 +120,9 @@ REQUEST_KEYS = {"kind", "uid", "arrival_s", "prompt_len", "gen_len",
                 "digests", "temperature", "top_k", "top_p",
                 "max_new_tokens", "outcome", "ttft_ms", "itl_ms",
                 "queue_wait_ms", "spec_drafted", "spec_accepted",
+                "spec_drafter", "spec_ngram_drafted",
+                "spec_ngram_accepted", "spec_model_drafted",
+                "spec_model_accepted",
                 "hit_device", "hit_host", "hit_disk", "hit_remote"}
 
 

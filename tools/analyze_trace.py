@@ -62,6 +62,30 @@ def __getattr__(name):
 _pct = replay_trace.percentile
 
 
+def recommend_spec_drafter(ngram_rate, model_rate,
+                           margin: float = 0.15):
+    """Recommend ``spec_drafter`` from per-drafter mined accept rates
+    (None = that drafter never drafted in the trace).  The host n-gram
+    drafter is free, the model drafter pays a draft-trunk forward per
+    step — so prefer "ngram" unless the model drafter's accept rate
+    beats it by ``margin``.  A low-accept n-gram workload with an
+    UNTRIED model drafter recommends "auto": let the per-request state
+    machine probe the draft trunk in production.  Both drafters mined
+    below the pay-off floor recommends "off" (run with
+    speculative=false).  Returns None when the trace has no
+    speculation at all."""
+    floor = 0.25
+    if ngram_rate is None and model_rate is None:
+        return None
+    if model_rate is None:
+        return "ngram" if ngram_rate >= floor else "auto"
+    if ngram_rate is None:
+        return "model" if model_rate >= floor else "off"
+    if max(ngram_rate, model_rate) < floor:
+        return "off"
+    return ("model" if model_rate >= ngram_rate + margin else "ngram")
+
+
 def recommend_spec_max_draft(accept_rate: float, cap: int = 8) -> int:
     """Recommend ``spec_max_draft`` from an observed per-draft accept
     rate ``p``: expected committed tokens per program with k drafts is
@@ -141,13 +165,19 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
     # current lattice with the observed spec Q bucket so enabled
     # speculation isn't misreported as uncovered
     spec_q = max((int(k[1]) for k in occ
-                  if len(k) > 4 and k[4] == "spec"), default=0)
+                  if len(k) > 4 and k[4] in ("spec", "draft_spec")),
+                 default=0)
+    # draft_spec/draft_fill keys imply a draft trunk was live: widen
+    # the current lattice with the draft twins (ISSUE 17)
+    draft_seen = any(len(k) > 4 and k[4] in ("draft_spec", "draft_fill")
+                     for k in occ)
     current = set(lattice_keys(
         max_prompt=max(prompt_lens), max_new_tokens=max(
             max(int(r["gen_len"]) for r in requests), 1),
         max_concurrency=mc, page_size=page,
         max_ragged_batch_size=batch_size, has_fresh=True,
-        sampling=True, spec_max_draft=max(spec_q - 1, 0)))
+        sampling=True, spec_max_draft=max(spec_q - 1, 0),
+        draft=draft_seen))
     uncovered = sorted(k for k in occ if k not in current)
 
     # -- recommended lattice ------------------------------------------
@@ -173,15 +203,37 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
     drafted = sum(int(r.get("spec_drafted", 0)) for r in requests)
     accepted = sum(int(r.get("spec_accepted", 0)) for r in requests)
     accept_rate = (accepted / drafted) if drafted else None
+    # per-drafter split (ISSUE 17): graceful on legacy traces, whose
+    # request records predate the spec_<drafter>_drafted/_accepted
+    # fields — the splits then read all-zero and the drafter
+    # recommendation falls back to the aggregate note below
+    per_drafter: Dict[str, Any] = {}
+    for name in ("ngram", "model"):
+        dn = sum(int(r.get(f"spec_{name}_drafted", 0))
+                 for r in requests)
+        an = sum(int(r.get(f"spec_{name}_accepted", 0))
+                 for r in requests)
+        per_drafter[name] = {
+            "drafted": dn, "accepted": an,
+            "accept_rate": (round(an / dn, 4) if dn else None)}
+    legacy = bool(requests) and not any(
+        "spec_drafter" in r for r in requests)
     speculation = {
         "drafted": drafted,
         "accepted": accepted,
         "accept_rate": (round(accept_rate, 4)
                         if accept_rate is not None else None),
+        "per_drafter": per_drafter,
         "recommended_spec_max_draft": (
             recommend_spec_max_draft(accept_rate)
             if accept_rate is not None else None),
-        "note": (None if drafted else
+        "recommended_spec_drafter": recommend_spec_drafter(
+            per_drafter["ngram"]["accept_rate"],
+            per_drafter["model"]["accept_rate"]),
+        "note": (("trace predates per-drafter ledger fields — "
+                  "aggregate accept rate only; recapture to mine a "
+                  "spec_drafter recommendation") if legacy and drafted
+                 else None if drafted else
                  "no speculation in this trace — capture with "
                  "serving_optimization.speculative=true (or replay "
                  "with tools/replay_trace.py --spec) to mine accept "

@@ -20,7 +20,9 @@
 #                      --spec pass replays the same workload with
 #                      speculative decoding on and checks the SAME
 #                      structural parity (speculation may change only
-#                      throughput/metrics, ISSUE 10)
+#                      throughput/metrics, ISSUE 10); a second arm
+#                      replays with --drafter model (ISSUE 17) so the
+#                      in-program draft head passes the same parity bar
 #   4. fleet smoke   — tools/fleetctl.py --smoke (ISSUE 11): spin two
 #                      debug serving replicas on ephemeral metrics
 #                      ports, scrape both, and assert the federated
@@ -85,6 +87,10 @@ python -m pytest tests/ -q -m chaos -p no:cacheprovider
 echo "== workload replay smoke (incl. speculative pass) =="
 python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
     --limit 32 --spec --check > /dev/null
+
+echo "== model-drafted speculative replay smoke (ISSUE 17) =="
+python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
+    --limit 32 --spec --drafter model --check > /dev/null
 
 echo "== tiered-KV smoke (4-page device cache forcing demotion) =="
 python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \

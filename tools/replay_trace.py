@@ -349,6 +349,8 @@ def _replay_impl(FastGenScheduler, SamplingParams, tm, engine, requests,
         "compile_on_path": tm.FASTGEN_COMPILE_ON_PATH.value - comp0,
         "spec_drafted": sched._spec_drafted_cum,
         "spec_accepted": sched._spec_accepted_cum,
+        "spec_draft_drafted": sched._spec_draft_drafted_cum,
+        "spec_draft_accepted": sched._spec_draft_accepted_cum,
     }
 
 
@@ -481,6 +483,8 @@ def replay_disagg(prefill_engine, decode_engine,
         "compile_on_path": tm.FASTGEN_COMPILE_ON_PATH.value - comp0,
         "spec_drafted": 0,
         "spec_accepted": 0,
+        "spec_draft_drafted": 0,
+        "spec_draft_accepted": 0,
         "handoffs": tm.DISAGG_HANDOFFS.value - hand0,
         "handoff_bytes": tm.DISAGG_HANDOFF_BYTES.value - bytes0,
         "handoff_p50_ms": percentile(handoff_ms, 50),
@@ -1111,7 +1115,8 @@ def run_replay(trace_path: str, limit: int = 0,
                model_size: str = "debug", seed: int = 0,
                warmup: bool = True,
                tolerance: float = 4.0,
-               spec: bool = False) -> Dict[str, Any]:
+               spec: bool = False,
+               drafter: str = "ngram") -> Dict[str, Any]:
     """The one load → filter → build → synthesize → (shape-warmup) →
     measured-replay → diff sequence, shared by the CLI, the CI smoke,
     and bench.py's BENCH_REPLAY leg — so the three can't drift on the
@@ -1119,7 +1124,12 @@ def run_replay(trace_path: str, limit: int = 0,
     workload is replayed a second time with speculative decoding
     enabled and the report gains a ``spec`` block: accept rate, tok/s
     on/off, and the spec pass's own structural-parity diff (ISSUE 10 —
-    speculation must change throughput and metrics, nothing else)."""
+    speculation must change throughput and metrics, nothing else).
+    ``drafter`` selects the spec pass's draft source (ISSUE 17):
+    ``ngram`` replays on the same engine; ``model``/``auto`` rebuild
+    the spec engine WITH the draft head (draft params and the parallel
+    draft-KV array are engine-level state), and the spec block gains a
+    per-drafter accept-rate split."""
     trace = load_trace(trace_path)
     requests = trace["requests"]
     if not include_errors:
@@ -1149,24 +1159,49 @@ def run_replay(trace_path: str, limit: int = 0,
            "replay": report, "diff": verdict}
     if spec:
         from deepspeed_tpu.inference.v2 import ServingOptimizationConfig
-        spec_serving = ServingOptimizationConfig(speculative=True)
+        spec_serving = ServingOptimizationConfig(speculative=True,
+                                                 spec_drafter=drafter)
+        if drafter == "ngram":
+            # same engine: the n-gram drafter is host-side state only
+            spec_engine = engine
+        else:
+            # model/auto need the draft head — draft params and the
+            # parallel draft-KV array are ENGINE-level state, so the
+            # spec pass gets its own engine built with the config
+            spec_engine = build_replay_engine(
+                meta, requests, model_size=model_size,
+                serving=spec_serving)
         if warmup:
-            _reset_engine(engine)
-            replay(engine, requests, prompts, speed=0.0,
+            _reset_engine(spec_engine)
+            replay(spec_engine, requests, prompts, speed=0.0,
                    serving=spec_serving)
-        _reset_engine(engine)
-        spec_report = replay(engine, requests, prompts, speed=speed,
+        _reset_engine(spec_engine)
+        spec_report = replay(spec_engine, requests, prompts, speed=speed,
                              serving=spec_serving)
         spec_diff = diff_replay(requests, prompts, page, spec_report,
                                 tolerance=tolerance)
         drafted = spec_report["spec_drafted"]
         off_tok_s = report["decode_tok_s"]
+
+        def _rate(acc, dr):
+            return round(acc / dr, 4) if dr else None
+
+        d_model = spec_report["spec_draft_drafted"]
+        a_model = spec_report["spec_draft_accepted"]
+        d_ngram = drafted - d_model
+        a_ngram = spec_report["spec_accepted"] - a_model
         out["spec"] = {
             "replay": spec_report, "diff": spec_diff,
-            "accept_rate": (round(spec_report["spec_accepted"] / drafted,
-                                  4) if drafted else None),
+            "drafter": drafter,
+            "accept_rate": _rate(spec_report["spec_accepted"], drafted),
             "drafted": drafted,
             "accepted": spec_report["spec_accepted"],
+            "per_drafter": {
+                "ngram": {"drafted": d_ngram, "accepted": a_ngram,
+                          "accept_rate": _rate(a_ngram, d_ngram)},
+                "model": {"drafted": d_model, "accepted": a_model,
+                          "accept_rate": _rate(a_model, d_model)},
+            },
             "tok_s_off": off_tok_s,
             "tok_s_on": spec_report["decode_tok_s"],
             "tok_s_ratio": (round(spec_report["decode_tok_s"]
@@ -1198,6 +1233,12 @@ def main(argv=None) -> int:
                     help="replay a second pass with speculative "
                     "decoding enabled and report accept rate + tok/s "
                     "delta (ISSUE 10)")
+    ap.add_argument("--drafter", default="ngram",
+                    choices=("ngram", "model", "auto"),
+                    help="draft source for the --spec pass (ISSUE 17): "
+                    "model/auto rebuild the spec engine with the "
+                    "in-program draft head and the report splits "
+                    "accept rate per drafter")
     ap.add_argument("--disagg", action="store_true",
                     help="replay through the two-pool disaggregated "
                     "prefill/decode scheduler (ISSUE 13): committed-"
@@ -1254,7 +1295,8 @@ def main(argv=None) -> int:
                              speed=args.speed,
                              model_size=args.model_size,
                              seed=args.seed, warmup=not args.no_warmup,
-                             tolerance=args.tolerance, spec=args.spec)
+                             tolerance=args.tolerance, spec=args.spec,
+                             drafter=args.drafter)
     except ValueError as e:
         print(f"replay_trace: {e}", file=sys.stderr)
         return 1
