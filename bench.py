@@ -817,6 +817,25 @@ def bench_fastgen(jax):
                 sys.stderr.write(f"bench: fastgen tier leg failed: "
                                  f"{e}\n")
                 result["fastgen_tier_error"] = str(e)[:300]
+        if os.environ.get("BENCH_SHARD", "0") != "0":
+            # sharded-serving leg (ISSUE 18): tp=1 vs tp=N fp vs tp=N
+            # int8 over the same shared-prefix greedy+keyed workload on
+            # a simulated --xla_force_host_platform_device_count mesh.
+            # Emits per-arm decode tok/s, tokenwise parity vs tp=1 (fp:
+            # every row; int8: greedy rows + sampled agreement rate),
+            # analytic collective wire bytes vs the fp-equivalent, and
+            # the measured passes' on-path compile count (0).  Runs in
+            # a subprocess — THIS process's jax initialized with the
+            # default single device long ago.  Off by default; own try.
+            try:
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                from tools.shard_bench import run_shard_bench
+                result.update(run_shard_bench())
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"bench: fastgen shard leg failed: "
+                                 f"{e}\n")
+                result["fastgen_shard_error"] = str(e)[:300]
         if os.environ.get("BENCH_COLDSTART", "0") != "0":
             # cold-start leg (ISSUE 14): three-way restore-to-first-
             # token comparison across REAL process boundaries — cold

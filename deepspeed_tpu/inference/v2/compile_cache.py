@@ -72,7 +72,10 @@ def _install_listener() -> None:
 def compile_config_digest(model_cfg: Any, kv_config: Any,
                           keyed_sampling: bool = False,
                           lattice_digest: str = "",
-                          draft_digest: str = "") -> str:
+                          draft_digest: str = "",
+                          tp_degree: int = 1,
+                          tp_collective_quantization: str = "none"
+                          ) -> str:
     """The (lattice + model-config + jaxlib) digest that namespaces one
     engine configuration's cache entries.  ``repr`` of the config
     dataclasses is stable across processes (no ids/addresses) and
@@ -91,6 +94,10 @@ def compile_config_digest(model_cfg: Any, kv_config: Any,
         # draft_spec/draft_fill programs — a draft-config change must
         # be a cache miss, never a wrong executable ("" = draft off)
         "draft": str(draft_digest),
+        # sharded serving (ISSUE 18): the mesh degree and collective
+        # encoding shape every compiled step — a mesh change must be a
+        # cache MISS, never a wrong executable
+        "tp": [int(tp_degree), str(tp_collective_quantization)],
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
     }

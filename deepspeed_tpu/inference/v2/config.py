@@ -158,6 +158,23 @@ class ServingOptimizationConfig:
     #: directory for the disk tier's page files ("" = a per-process
     #: temp dir, deleted with the store)
     kv_tier_dir: str = ""
+    # -- sharded fused serving (ISSUE 18) -------------------------------
+    #: tensor-parallel degree for the ONE compiled serving program:
+    #: weights shard along a ``tp`` mesh axis, KV pages partition along
+    #: KV heads (page ids/tables stay replicated — the allocator,
+    #: prefix cache, tiering, and chained digests are shard-invariant),
+    #: and sampling stays on-device behind an in-program logits
+    #: all-gather.  1 = single-device (the pre-ISSUE-18 engine).
+    #: Engine-build-time: part of the compile-cache digest, so a mesh
+    #: change is a cache MISS, never a wrong executable
+    tp_degree: int = 1
+    #: encoding for the in-program cross-shard logits collective:
+    #: "none" (fp all-gather, tokenwise identical to tp=1) or "int8"
+    #: (block-scaled int8 codes + one fp32 scale per row per shard —
+    #: ~4x fewer interconnect bytes; argmax is preserved whenever the
+    #: top-1 margin exceeds half the largest per-shard quantization
+    #: step, see DESIGN.md "Sharded serving")
+    tp_collective_quantization: str = "none"
 
 
 @dataclasses.dataclass

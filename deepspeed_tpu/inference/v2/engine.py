@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ...telemetry import metrics as tm
 from ...telemetry import trace_span
 from ...utils.comms_logging import serving_counters
 from .config import RaggedInferenceEngineConfig
@@ -151,6 +152,40 @@ class InferenceEngineV2:
                  config: Optional[RaggedInferenceEngineConfig] = None):
         self._config = config or RaggedInferenceEngineConfig()
         self._model = model
+        # sharded fused serving (ISSUE 18): the mesh must land FIRST —
+        # before weight quantization (quantized leaves carry no
+        # logical-axis metadata to shard by) and before anything that
+        # traces or sizes against the params/KV layout.  tp=1 with no
+        # pre-built mesh keeps the engine byte-identical to pre-18.
+        svtp = self._config.serving
+        tp = int(getattr(svtp, "tp_degree", 1) or 1)
+        tpq = getattr(svtp, "tp_collective_quantization", "none") or "none"
+        if tpq not in ("none", "int8"):
+            raise ValueError(
+                f"serving_optimization.tp_collective_quantization={tpq!r}"
+                " is not a supported encoding — choose 'none' (fp "
+                "all-gather) or 'int8' (block-scaled codes + scales)")
+        if tp > 1 and model.mesh is None:
+            devs = jax.devices()
+            if len(devs) < tp:
+                raise ValueError(
+                    f"serving_optimization.tp_degree={tp} needs {tp} "
+                    f"devices but only {len(devs)} are visible — on a "
+                    "chipless box simulate a mesh with XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count={tp} "
+                    "(set BEFORE jax import)")
+            model.apply_mesh(jax.sharding.Mesh(
+                np.asarray(devs[:tp]).reshape(tp), ("tp",)))
+        # the collective encoding shapes every traced program (like
+        # keyed_sampling) — set before any precompile
+        model.tp_collective_quantization = tpq
+        self._tp_degree = model.tp_degree
+        if tp > 1 and self._tp_degree != tp:
+            raise ValueError(
+                f"serving_optimization.tp_degree={tp} but the model's "
+                f"mesh shards the tp axis {self._tp_degree}-way — the "
+                "pre-built mesh and the serving config disagree")
+        tm.FASTGEN_SHARD_COUNT.set(float(self._tp_degree))
         if self._config.quantization.enabled:
             # NOTE: the engine takes ownership of the model — this
             # rewrites model.params in place (quantize_weights is
@@ -284,7 +319,9 @@ class InferenceEngineV2:
                 keyed_sampling=model.keyed_sampling,
                 lattice_digest=(self._lattice.digest
                                 if self._lattice is not None else ""),
-                draft_digest=self.draft_digest)
+                draft_digest=self.draft_digest,
+                tp_degree=self._tp_degree,
+                tp_collective_quantization=tpq)
             self._compile_cache_dir = enable_compile_cache(cache_dir,
                                                            digest)
         sv = self._config.serving

@@ -141,52 +141,24 @@ class RaggedInferenceModel:
         self.kv_config = kv_config or KVCacheConfig(
             num_layers=cfg.num_layers, kv_heads=cfg.kv_heads,
             head_dim=cfg.dims_per_head, dtype=cfg.dtype)
-        if mesh is not None and not T._has_boxes(params):
-            # HF-imported trees are unboxed; recover the logical axes
-            # from the family's own init so AutoTP actually shards
-            params = _rebox_from_cfg(cfg, params)
-        if mesh is not None and T._has_boxes(params):
-            # TP sharding: heads/ffn/vocab over the 'tensor' mesh axis (the
-            # AutoTP analogue — reference module_inject/auto_tp.py slices
-            # Linears row/col; GSPMD derives the same split + collectives
-            # from these specs).  Logical axes come from the Partitioned
-            # boxes the model init attached.
-            from ...runtime.zero.partitioner import logical_to_mesh_spec
-            rules = {"heads": "tensor", "kv": "tensor", "mlp": "tensor",
-                     "vocab": "tensor", "expert": "expert"}
-
-            def _shard(leaf):
-                if isinstance(leaf, T.meta.Partitioned):
-                    spec = logical_to_mesh_spec(tuple(leaf.names), rules)
-                    # drop axes absent from this mesh (a tp-only serving
-                    # mesh has no 'expert' axis) or not dividing the dim
-                    # (reference AutoTP keeps indivisible modules
-                    # unsharded)
-                    entries = []
-                    for i, entry in enumerate(spec):
-                        axes = (entry if isinstance(entry, tuple)
-                                else (entry,)) if entry else ()
-                        axes = tuple(a for a in axes
-                                     if a in mesh.axis_names)
-                        size = 1
-                        for a in axes:
-                            size *= mesh.shape[a]
-                        ok = axes and leaf.value.shape[i] % size == 0
-                        entries.append(
-                            (axes if len(axes) > 1 else axes[0])
-                            if ok else None)
-                    return jax.device_put(
-                        leaf.value,
-                        jax.sharding.NamedSharding(mesh, P(*entries)))
-                return jax.device_put(
-                    leaf, jax.sharding.NamedSharding(mesh, P()))
-
-            params = jax.tree.map(
-                _shard, params,
-                is_leaf=lambda x: isinstance(x, T.meta.Partitioned))
-        else:
-            params = T.meta.unbox(params) if T._has_boxes(params) else params
+        #: which mesh axis shards heads/ffn/vocab (and the KV head dim):
+        #: the serving ``tp`` axis when present, else the training-side
+        #: ``tensor`` axis.  None until a mesh is applied.
+        self._tp_axis: Optional[str] = None
+        #: cross-shard logits collective encoding (ISSUE 18): "none" =
+        #: the fp all-gather GSPMD derives from the vocab-sharded lm
+        #: head (tokenwise identical to tp=1), "int8" = block-scaled
+        #: codes + one fp32 scale per row per shard assembled inside
+        #: the compiled program via shard_map.  Set by the engine from
+        #: ``serving.tp_collective_quantization`` BEFORE any precompile
+        #: (it changes the traced programs, like ``keyed_sampling``).
+        self.tp_collective_quantization = "none"
+        if mesh is None and T._has_boxes(params):
+            params = T.meta.unbox(params)
         self.params = params
+        if mesh is not None:
+            self.mesh = None        # apply_mesh owns the assignment
+            self.apply_mesh(mesh)
         self._step_cache: Dict[Tuple[int, int, int], Callable] = {}
         #: schedule-invariant sampling (ISSUE 13): when True every
         #: sampling-capable step kind takes two extra [S] int32 inputs
@@ -288,15 +260,104 @@ class RaggedInferenceModel:
         self._step_cache.clear()
         self._program_costs.clear()   # quantized programs re-cost
 
+    # -- tensor-parallel sharding (ISSUE 18) -------------------------------
+    def apply_mesh(self, mesh: jax.sharding.Mesh) -> None:
+        """Shard this model's params onto ``mesh`` along its ``tp``
+        (serving) or ``tensor`` (training) axis: heads/ffn/vocab over
+        the axis (the AutoTP analogue — reference
+        module_inject/auto_tp.py slices Linears row/col; GSPMD derives
+        the same split + collectives from these specs).  Logical axes
+        come from the Partitioned boxes the model init attached; an
+        unboxed tree (HF import, or a model built without a mesh) is
+        re-boxed from the family's own init first.  Engine-build-time:
+        call BEFORE ``quantize_weights`` (quantized leaves carry no
+        logical axes) and before any precompile — the step cache is
+        cleared because every compiled program changes."""
+        axis = next((a for a in ("tp", "tensor") if a in mesh.axis_names),
+                    None)
+        if axis is None:
+            raise ValueError(
+                f"mesh axes {mesh.axis_names} have no 'tp' or 'tensor' "
+                "axis to shard the serving program over")
+        if getattr(self, "_quantized_fmt", None) is not None:
+            raise ValueError(
+                "apply_mesh must run before quantize_weights — "
+                "quantized leaves carry no logical-axis metadata")
+        params = self.params
+        if not T._has_boxes(params):
+            # HF-imported trees are unboxed; recover the logical axes
+            # from the family's own init so AutoTP actually shards
+            params = _rebox_from_cfg(self.cfg, params)
+        from ...runtime.zero.partitioner import logical_to_mesh_spec
+        rules = {"heads": axis, "kv": axis, "mlp": axis,
+                 "vocab": axis, "expert": "expert"}
+
+        def _shard(leaf):
+            if isinstance(leaf, T.meta.Partitioned):
+                spec = logical_to_mesh_spec(tuple(leaf.names), rules)
+                # drop axes absent from this mesh (a tp-only serving
+                # mesh has no 'expert' axis) or not dividing the dim
+                # (reference AutoTP keeps indivisible modules
+                # unsharded)
+                entries = []
+                for i, entry in enumerate(spec):
+                    axes = (entry if isinstance(entry, tuple)
+                            else (entry,)) if entry else ()
+                    axes = tuple(a for a in axes
+                                 if a in mesh.axis_names)
+                    size = 1
+                    for a in axes:
+                        size *= mesh.shape[a]
+                    ok = axes and leaf.value.shape[i] % size == 0
+                    entries.append(
+                        (axes if len(axes) > 1 else axes[0])
+                        if ok else None)
+                return jax.device_put(
+                    leaf.value,
+                    jax.sharding.NamedSharding(mesh, P(*entries)))
+            return jax.device_put(
+                leaf, jax.sharding.NamedSharding(mesh, P()))
+
+        self.params = jax.tree.map(
+            _shard, params,
+            is_leaf=lambda x: isinstance(x, T.meta.Partitioned))
+        self.mesh = mesh
+        self._tp_axis = axis
+        cache = getattr(self, "_step_cache", None)
+        if cache:
+            cache.clear()
+            self._program_costs.clear()   # sharded programs re-cost
+
+    @property
+    def tp_degree(self) -> int:
+        """Size of the tensor-parallel axis (1 = unsharded)."""
+        if self.mesh is None or self._tp_axis is None:
+            return 1
+        return int(self.mesh.shape[self._tp_axis])
+
+    def _tp_quant_active(self) -> bool:
+        """Whether the int8 block-scaled logits collective replaces the
+        fp all-gather: needs a mesh, the int8 encoding selected, and a
+        vocab the axis divides (an indivisible vocab stays replicated,
+        so there is no collective to quantize)."""
+        return (self.mesh is not None and self._tp_axis is not None
+                and self.tp_collective_quantization == "int8"
+                and self.tp_degree > 1
+                and self.cfg.vocab_size % self.tp_degree == 0)
+
     # -- sharding of the KV cache ------------------------------------------
     def kv_sharding(self) -> Optional[jax.sharding.Sharding]:
         if self.mesh is None:
             return None
-        # [L, pages, page, 2, K, D]: shard kv heads over 'tensor'
-        if self.kv_config.kv_heads % max(
-                self.mesh.shape.get("tensor", 1), 1) == 0:
+        # [L, pages, page, 2, K, D]: partition kv heads over the tp
+        # axis — each shard's page slab holds only its head slice,
+        # while page ids/tables (host-side int32) stay replicated, so
+        # the allocator/prefix-cache/tiering view is shard-invariant
+        axis = self._tp_axis
+        if axis is not None and self.kv_config.kv_heads % max(
+                self.mesh.shape.get(axis, 1), 1) == 0:
             return jax.sharding.NamedSharding(
-                self.mesh, P(None, None, None, None, "tensor", None))
+                self.mesh, P(None, None, None, None, axis, None))
         return jax.sharding.NamedSharding(self.mesh, P())
 
     # -- forward ------------------------------------------------------------
@@ -538,7 +599,51 @@ class RaggedInferenceModel:
         wt = get_workload_trace()
         if wt.active:
             wt.note_step_key(key)
+        self._account_tp_collective(key)
         self._account_cost(key)
+
+    def _tp_logits_rows(self, key) -> int:
+        """Logits rows one dispatch of ``key`` assembles cross-shard
+        (the [N, V] arrays behind the in-program all-gather): last-token
+        kinds gather S rows, the spec verify gathers every position
+        (S*Q), draft_spec adds one [S] draft gather per scan iteration
+        on top of its verify, mixed sums its two segments, and
+        draft_fill has no unembed consumer at all."""
+        kind = key[4] if len(key) > 4 else "logits"
+        S = int(key[0])
+        if kind in ("logits", "sample", "chain"):
+            return S
+        if kind == "spec":
+            return S * int(key[1])
+        if kind == "draft_spec":
+            return 2 * S * int(key[1])
+        if kind == "mixed":
+            return S + int(key[5])
+        return 0                                         # draft_fill
+
+    def _account_tp_collective(self, key) -> None:
+        """Analytic interconnect accounting for the logits collective
+        (host-side adds — nothing touches the device).  Wire bytes are
+        what each shard RECEIVES, summed over shards: fp all-gather
+        moves N*V*(tp-1) fp32 entries; the int8 encoding moves the
+        same entries as 1-byte codes plus one fp32 scale per row per
+        remote shard.  The fp32-equivalent counter is always fed, so
+        ``collective_bytes / collective_fp_bytes`` reads as the
+        encoding's compression ratio."""
+        tp = self.tp_degree
+        if tp <= 1:
+            return
+        n = self._tp_logits_rows(key)
+        if not n:
+            return
+        v = int(self.cfg.vocab_size)
+        fp_bytes = n * v * (tp - 1) * 4
+        if self._tp_quant_active():
+            wire = n * v * (tp - 1) + n * tp * (tp - 1) * 4
+        else:
+            wire = fp_bytes
+        tm.FASTGEN_SHARD_COLLECTIVE_BYTES.inc(wire)
+        tm.FASTGEN_SHARD_COLLECTIVE_FP_BYTES.inc(fp_bytes)
 
     def _account_cost(self, key) -> None:
         cost = self._program_costs.get(key)
@@ -576,6 +681,15 @@ class RaggedInferenceModel:
 
         tm.FASTGEN_MFU.bind(rate("_flops_dispatched", peak))
         tm.FASTGEN_BYTES_PER_S.bind(rate("_bytes_dispatched"))
+        # per-shard view (ISSUE 18): cost_analysis() reports the whole
+        # logical program; each of the tp shards executes 1/tp of it
+        # against ONE device's peak, so the per-shard gauges divide the
+        # dispatched totals by the mesh degree (tp=1 ⇒ they read the
+        # same as the global pair)
+        tp = float(max(self.tp_degree, 1))
+        tm.FASTGEN_SHARD_MFU.bind(rate("_flops_dispatched", peak * tp))
+        tm.FASTGEN_SHARD_BYTES_PER_S.bind(
+            rate("_bytes_dispatched", tp))
 
     def reset_cost_window(self) -> None:
         """Re-open the MFU/bytes-per-s window (bench measured-window
@@ -696,6 +810,58 @@ class RaggedInferenceModel:
                 if cfg.tie_embeddings
                 else params["lm_head"].astype(cfg.dtype))
 
+    # dslint: hot-path
+    def _assemble_logits(self, x2d, lm_head, bias=None):
+        """[N, E] hidden rows -> [N, V] fp32 logits, replicated on
+        every shard.  Unsharded (or ``tp_collective_quantization =
+        "none"``): a plain matmul — under a mesh the vocab-sharded lm
+        head leaves the product sharded on V and GSPMD inserts the fp
+        all-gather where sampling forces replication, tokenwise
+        identical to tp=1.  "int8": the gather is taken over explicitly
+        via shard_map — each shard computes its [N, V/tp] slice in
+        fp32, encodes it as block-scaled int8 (one symmetric fp32
+        scale per row per shard, the PR 1/PR 16 quantizer idiom:
+        scale = max|x| / 127), all-gathers codes + scales (~4x fewer
+        interconnect bytes than fp32), and decodes — every shard
+        reconstructs the same [N, V] array, so sampling stays
+        shard-deterministic.  Numeric contract: each row's per-shard
+        max round-trips exactly; any other entry moves by at most
+        scale/2, so argmax is preserved whenever the top-1 margin
+        exceeds half the largest per-shard quantization step (see
+        DESIGN.md "Sharded serving").  Bias lands after assembly
+        (replicated, [V]-small)."""
+        cfg = self.cfg
+        if not self._tp_quant_active():
+            logits = jnp.einsum("ne,ev->nv", x2d, lm_head)
+            if bias is not None:
+                logits = logits + bias.astype(cfg.dtype)
+            return logits.astype(jnp.float32)
+        from ...utils.jax_compat import shard_map
+        mesh, axis = self.mesh, self._tp_axis
+
+        def local(xl, wl):
+            # wl: this shard's [E, V/tp] vocab slice (contiguous —
+            # shard i holds columns [i*V/tp, (i+1)*V/tp))
+            part = jnp.einsum("ne,ev->nv", xl, wl).astype(jnp.float32)
+            scale = jnp.max(jnp.abs(part), axis=-1) / 127.0      # [N]
+            codes = jnp.clip(
+                jnp.round(part / jnp.maximum(scale, 1e-30)[:, None]),
+                -127, 127).astype(jnp.int8)
+            codes = jax.lax.all_gather(codes, axis)    # [tp, N, V/tp]
+            scales = jax.lax.all_gather(scale, axis)   # [tp, N]
+            full = codes.astype(jnp.float32) * scales[:, :, None]
+            # shard order along dim 0 IS vocab-slice order: interleave
+            # back to one contiguous [N, V]
+            return jnp.moveaxis(full, 0, 1).reshape(xl.shape[0], -1)
+
+        logits = shard_map(local, mesh=mesh,
+                           in_specs=(P(), P(None, axis)),
+                           out_specs=P(), check_vma=False)(
+            x2d.astype(cfg.dtype), lm_head)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        return logits
+
     def _forward_hidden(self, params, kv, token_ids, q_lens, start_pos,
                         page_table, fresh: bool = False, cfg=None):
         """The shared trunk of every step kind: embed -> layers -> final
@@ -742,9 +908,16 @@ class RaggedInferenceModel:
         cfg = self.cfg
         x, kv = self._forward_hidden(params, kv, token_ids, q_lens,
                                      start_pos, page_table, fresh=fresh)
+        bias = params.get("lm_head_bias")  # phi family ships one
+        if self._tp_quant_active():
+            # int8 collective path mirrors the default unembed module
+            # (last-token gather + matmul) with the gather quantized
+            logits = self._assemble_logits(gather_last(x, q_lens),
+                                           self._lm_head(params), bias)
+            return logits, kv
         logits = self._unembed(x, q_lens, self._lm_head(params))  # [S, V]
-        if "lm_head_bias" in params:  # phi family ships an lm_head bias
-            logits = logits + params["lm_head_bias"].astype(cfg.dtype)
+        if bias is not None:
+            logits = logits + bias.astype(cfg.dtype)
         return logits.astype(jnp.float32), kv
 
     def _sample_tokens(self, logits, rng, temps, top_ks, top_ps,
@@ -810,10 +983,13 @@ class RaggedInferenceModel:
         Returns [S, 2] int32: (accepted_count, corrected_token)."""
         x, kv = self._forward_hidden(params, kv, token_ids, q_lens,
                                      start_pos, page_table, fresh=False)
-        logits = jnp.einsum("sqe,ev->sqv", x, self._lm_head(params))
-        if "lm_head_bias" in params:
-            logits = logits + params["lm_head_bias"].astype(self.cfg.dtype)
-        logits = logits.astype(jnp.float32)                  # [S, Q, V]
+        # EVERY position unembeds (the verify reads all of them) —
+        # flattened through the shared assembly so the tp collective
+        # (fp or int8) covers the spec kinds too
+        Sx, Qx, E = x.shape
+        logits = self._assemble_logits(
+            x.reshape(Sx * Qx, E), self._lm_head(params),
+            params.get("lm_head_bias")).reshape(Sx, Qx, -1)  # [S, Q, V]
         S, Q, V = logits.shape
         if greedy_only:
             emitted = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -881,11 +1057,10 @@ class RaggedInferenceModel:
             x, dkv = self._forward_hidden(
                 dparams, dkv, tok[:, None], qj, start_pos + j,
                 page_table, fresh=False, cfg=dcfg)
-            logits = jnp.einsum("se,ev->sv", x[:, 0, :], lm_head)
-            if bias is not None:
-                logits = logits + bias
-            nxt = jnp.argmax(logits.astype(jnp.float32),
-                             axis=-1).astype(jnp.int32)
+            # shared assembly: the per-iteration [S, V] draft logits
+            # ride the same tp collective (fp or int8) as the verify
+            logits = self._assemble_logits(x[:, 0, :], lm_head, bias)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (dkv, nxt), nxt
 
         (draft_kv, _), emitted = jax.lax.scan(

@@ -528,6 +528,16 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
     kv_tier_disk_pages: int = 0
     #: directory for disk-tier page files ("" = per-process temp dir)
     kv_tier_dir: str = ""
+    # -- sharded fused serving (ISSUE 18) ------------------------------
+    #: tensor-parallel degree for the fused serving program (1 =
+    #: single-device); weights shard along a ``tp`` mesh axis and KV
+    #: pages partition along KV heads — engine-build-time, part of the
+    #: compile-cache digest
+    tp_degree: int = 1
+    #: cross-shard logits collective encoding: "none" (fp all-gather,
+    #: tokenwise identical to tp=1) or "int8" (block-scaled codes +
+    #: per-row-per-shard fp32 scales — ~4x fewer interconnect bytes)
+    tp_collective_quantization: str = "none"
 
     def to_v2_dict(self) -> Dict[str, Any]:
         """The ``serving_optimization`` dict the inference-v2 config
@@ -554,7 +564,10 @@ class ServingOptimizationConfig(DeepSpeedConfigModel):
                 "kv_quantization": self.kv_quantization,
                 "kv_tier_host_pages": self.kv_tier_host_pages,
                 "kv_tier_disk_pages": self.kv_tier_disk_pages,
-                "kv_tier_dir": self.kv_tier_dir}
+                "kv_tier_dir": self.kv_tier_dir,
+                "tp_degree": self.tp_degree,
+                "tp_collective_quantization":
+                    self.tp_collective_quantization}
 
 
 class TPUConfig(DeepSpeedConfigModel):

@@ -220,6 +220,31 @@ FASTGEN_BYTES_PER_S = registry.gauge(
     "serving HBM traffic rate: dispatched program bytes accessed / "
     "wall since the cost window opened")
 
+# -- sharded fused serving (ISSUE 18) ----------------------------------------
+FASTGEN_SHARD_COUNT = registry.gauge(
+    "ds_fastgen_shard_count",
+    "tensor-parallel degree of the fused serving program (1 = "
+    "unsharded; set at engine build from serving.tp_degree)")
+FASTGEN_SHARD_MFU = registry.gauge(
+    "ds_fastgen_shard_mfu",
+    "per-shard serving MFU: dispatched program FLOPs / tp / wall / "
+    "one device's peak (cost_analysis covers the whole logical "
+    "program, each shard executes 1/tp of it)")
+FASTGEN_SHARD_BYTES_PER_S = registry.gauge(
+    "ds_fastgen_shard_bytes_per_s",
+    "per-shard HBM traffic rate: dispatched program bytes / tp / "
+    "wall since the cost window opened")
+FASTGEN_SHARD_COLLECTIVE_BYTES = registry.counter(
+    "ds_fastgen_shard_collective_bytes_total",
+    "analytic interconnect bytes moved by the in-program logits "
+    "all-gather at its configured encoding (int8 codes + fp32 "
+    "scales, or fp32 when tp_collective_quantization=none)")
+FASTGEN_SHARD_COLLECTIVE_FP_BYTES = registry.counter(
+    "ds_fastgen_shard_collective_fp_bytes_total",
+    "fp32-equivalent interconnect bytes of the same logits "
+    "all-gathers — the denominator for the encoding's compression "
+    "ratio")
+
 # -- speculative decoding (ISSUE 10) -----------------------------------------
 FASTGEN_SPEC_DRAFTED = registry.counter(
     "ds_fastgen_spec_drafted_total",

@@ -663,6 +663,18 @@ class TestTwoReplicaKillDemo:
         stay coherent (dead replica stale-flagged, merged counters
         monotone, survivor still serving) while the burn-rate
         evaluator pages with scale-up advice."""
+        # The demo's signal is "fleet token rate tracks live-replica
+        # count".  That premise needs at least one core per replica:
+        # on a single-core box the two replicas serialize, so the
+        # fleet rate is CPU-bound — killing r1 frees the core, the
+        # survivor's step rate roughly doubles, the total rate never
+        # drops below the goodput floor, and there is nothing for the
+        # evaluator to page on.
+        if (os.cpu_count() or 1) < 2:
+            pytest.skip("replica-kill demo needs >= 2 cores; with the "
+                        "replicas serialized on one core the fleet "
+                        "token rate tracks CPU time, not live-replica "
+                        "count")
         from fleetctl import ReplicaProc
         telemetry.enable()
         trace = os.path.join(
@@ -693,11 +705,38 @@ class TestTwoReplicaKillDemo:
                 assert r.wait_line("round=0 done", 240.0) is not None, \
                     f"{r.label} never finished warmup (exit=" \
                     f"{r.proc.poll()})"
+            # warm rate: POLL instead of one fixed 2.4 s window — on a
+            # 1-core box the two replica subprocesses serialize, and a
+            # single window can straddle a scheduling gap where neither
+            # replica committed a token (rate reads 0 and the demo
+            # flakes).  Keep sampling until the both-alive rate is
+            # visibly positive; the r1-alive assertion below still
+            # guards against pinning the objective to a post-kill rate.
+            warm = None
             ts.sample_now()
-            time.sleep(2.4)
-            ts.sample_now()
-            warm = ts.counter_rate("ds_fastgen_tokens_total", 5.0)
-            assert warm and warm > 0
+            warm_deadline = time.monotonic() + 120.0
+            while time.monotonic() < warm_deadline:
+                time.sleep(0.3)
+                ts.sample_now()
+                warm = ts.counter_rate("ds_fastgen_tokens_total", 5.0)
+                if warm and warm > 0:
+                    break
+            assert warm and warm > 0, \
+                "fleet token rate never went positive while both " \
+                "replicas were alive"
+            # the FIRST positive reading on a serialized box can be a
+            # thin trickle (one replica's tokens in an otherwise idle
+            # window); pinning the objective to it would set the
+            # goodput floor so low the post-kill half-fleet still
+            # clears it and the evaluator never pages.  Sample a few
+            # more seconds and take the best observed both-alive rate.
+            settle_deadline = time.monotonic() + 4.0
+            while time.monotonic() < settle_deadline:
+                time.sleep(0.3)
+                ts.sample_now()
+                rate = ts.counter_rate("ds_fastgen_tokens_total", 5.0)
+                if rate and rate > warm:
+                    warm = rate
             assert reps[1].proc.poll() is None, \
                 "r1 died before the both-alive rate was measured"
             ev.configure([{

@@ -50,9 +50,12 @@ _LATENCY_RE = re.compile(r"_ms$")
 #: coldstart_* spans subprocess spawns + disk I/O (ISSUE 14) — the
 #: in-round coldstart_findings gate carries the hard invariants;
 #: tier_* spans disk AIO + replica-to-replica transfer timing
-#: (ISSUE 16) — its hard invariants live in tier_findings
+#: (ISSUE 16) — its hard invariants live in tier_findings;
+#: fastgen_shard_* times shard arithmetic on oversubscribed host cores
+#: (a simulated mesh, ISSUE 18) — its hard invariants (parity, wire
+#: bytes, on-path compiles) live in shard_findings
 _FLEET_RE = re.compile(
-    r"^(fastgen_fleet_|pool_|disagg_|coldstart_|tier_)")
+    r"^(fastgen_fleet_|fastgen_shard_|pool_|disagg_|coldstart_|tier_)")
 #: parsed keys that are not a measured quantity at all
 _SKIP_RE = re.compile(
     r"(^metric$|^unit$|error|^cpu_fallback$|_model$|_path$|_policy$|"
@@ -301,6 +304,47 @@ def tier_findings(cur: Dict) -> List[str]:
     return out
 
 
+def shard_findings(cur: Dict) -> List[str]:
+    """In-round sharded-serving gate (ISSUE 18): the acceptance
+    invariants of the BENCH_SHARD leg — the tp-way fp arm tokenwise
+    identical to tp=1 on every row (sampled included; the GSPMD
+    all-gather is bit-exact), the int8 arm tokenwise identical on the
+    greedy rows (bounded quantization error may flip a keyed draw that
+    thresholds on exact logit values — agreement there is a reported
+    rate, not a gate), the int8 collective moving STRICTLY fewer wire
+    bytes than the fp-equivalent of the same dispatches, and zero
+    on-path compiles across the measured passes (tp is in the
+    compile-cache digest: a mesh change is a MISS, never a wrong
+    executable — but the warmed lattice must still cover every sharded
+    step key)."""
+    out: List[str] = []
+    if "fastgen_shard_tp" not in cur:
+        return out      # leg didn't run this round
+    if cur.get("fastgen_shard_parity_fp") in (0, False):
+        out.append("sharded fp arm is NOT tokenwise identical to tp=1 "
+                   "— the one-program step's sharding leaks into "
+                   "results (kv partitioning / keyed sampling / "
+                   "collective placement broken?)")
+    if cur.get("fastgen_shard_parity_int8") in (0, False):
+        out.append("int8-collective arm is NOT tokenwise identical to "
+                   "tp=1 on the GREEDY rows — the top-1 margin should "
+                   "dominate the per-shard quantization step on the "
+                   "debug model; check the block-scale/dequant math")
+    wire = cur.get("fastgen_shard_int8_wire_bytes")
+    fp = cur.get("fastgen_shard_int8_wire_fp_bytes")
+    if (isinstance(wire, (int, float)) and isinstance(fp, (int, float))
+            and not (0 < wire < fp)):
+        out.append(f"int8 collective wire bytes ({wire}) are not "
+                   f"strictly below the fp-equivalent ({fp}) — the "
+                   "quantized encoding stopped paying for itself")
+    comp = cur.get("fastgen_shard_compile_on_path_total")
+    if isinstance(comp, (int, float)) and comp > 0:
+        out.append(f"shard bench measured passes compiled {int(comp)} "
+                   "program(s) on-path (warmup no longer covers the "
+                   "sharded step-key set)")
+    return out
+
+
 def coldstart_findings(cur: Dict) -> List[str]:
     """In-round cold-start gate (ISSUE 14).  The recompile-proof
     invariants (zero on-path compiles, zero true compiles, tokenwise
@@ -380,6 +424,7 @@ def main(argv=None) -> int:
     findings += [("note", m) for m in pool_findings(cur)]
     findings += [("note", m) for m in disagg_findings(cur)]
     findings += [("note", m) for m in tier_findings(cur)]
+    findings += [("note", m) for m in shard_findings(cur)]
     findings += [("note", m) for m in coldstart_findings(cur)]
     regressions = [m for sev, m in findings if sev == "regression"]
     notes = [m for sev, m in findings if sev == "note"]

@@ -23,6 +23,13 @@
 #                      throughput/metrics, ISSUE 10); a second arm
 #                      replays with --drafter model (ISSUE 17) so the
 #                      in-program draft head passes the same parity bar
+#   3a. shard smoke  — tools/replay_trace.py --tp 2 --check
+#                      (ISSUE 18): the same 32 requests replayed on a
+#                      2-way simulated tensor-parallel mesh (host
+#                      device count forced before jax loads); asserts
+#                      the base structural parity PLUS zero on-path
+#                      compiles and zero structured errors — sharding
+#                      may change wire bytes, nothing the user sees
 #   4. fleet smoke   — tools/fleetctl.py --smoke (ISSUE 11): spin two
 #                      debug serving replicas on ephemeral metrics
 #                      ports, scrape both, and assert the federated
@@ -91,6 +98,10 @@ python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
 echo "== model-drafted speculative replay smoke (ISSUE 17) =="
 python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
     --limit 32 --spec --drafter model --check > /dev/null
+
+echo "== sharded replay smoke (tp=2 simulated mesh, ISSUE 18) =="
+python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
+    --limit 32 --tp 2 --check > /dev/null
 
 echo "== tiered-KV smoke (4-page device cache forcing demotion) =="
 python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \

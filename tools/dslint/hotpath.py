@@ -40,7 +40,8 @@ REQUIRED_HOT_PATHS: Tuple[Tuple[str, str], ...] = (
     ("deepspeed_tpu/inference/v2/scheduler.py",
      r"^(_drain_impl|_step_impl|_dispatch_chain|_dispatch_spec"
      r"|_dispatch_draft_spec)$"),
-    ("deepspeed_tpu/inference/v2/model.py", r"^_\w*step_impl$"),
+    ("deepspeed_tpu/inference/v2/model.py",
+     r"^(_\w*step_impl|_assemble_logits)$"),
     ("deepspeed_tpu/inference/v2/engine.py",
      r"^(_commit_batch|commit_spec)$"),
 )

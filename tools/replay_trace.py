@@ -44,6 +44,35 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 
+# -- simulated-mesh prelude (ISSUE 18) ---------------------------------------
+def _tp_from_argv(argv) -> int:
+    """Peek ``--tp N`` out of raw argv.  The host platform's device
+    count is an env knob jax reads at import, so it must be set before
+    argparse runs (argparse imports nothing, but the first lazy
+    ``import jax`` below it wins the race otherwise)."""
+    for i, a in enumerate(argv):
+        if a == "--tp" and i + 1 < len(argv):
+            try:
+                return int(argv[i + 1])
+            except ValueError:
+                return 1
+        if a.startswith("--tp="):
+            try:
+                return int(a.split("=", 1)[1])
+            except ValueError:
+                return 1
+    return 1
+
+
+if __name__ == "__main__":
+    _tp_pre = _tp_from_argv(sys.argv[1:])
+    if _tp_pre > 1 and "jax" not in sys.modules:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={_tp_pre}")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
 def percentile(vals, q: float):
     """Nearest-rank percentile over values (None entries dropped);
     None when empty.  The one implementation the replay report, the
@@ -1116,7 +1145,8 @@ def run_replay(trace_path: str, limit: int = 0,
                warmup: bool = True,
                tolerance: float = 4.0,
                spec: bool = False,
-               drafter: str = "ngram") -> Dict[str, Any]:
+               drafter: str = "ngram",
+               tp: int = 1) -> Dict[str, Any]:
     """The one load → filter → build → synthesize → (shape-warmup) →
     measured-replay → diff sequence, shared by the CLI, the CI smoke,
     and bench.py's BENCH_REPLAY leg — so the three can't drift on the
@@ -1129,7 +1159,11 @@ def run_replay(trace_path: str, limit: int = 0,
     ``ngram`` replays on the same engine; ``model``/``auto`` rebuild
     the spec engine WITH the draft head (draft params and the parallel
     draft-KV array are engine-level state), and the spec block gains a
-    per-drafter accept-rate split."""
+    per-drafter accept-rate split.  ``tp`` shards the replay engine
+    over a ``tp``-way simulated mesh (ISSUE 18) — the replay must stay
+    tokenwise/structurally identical to the unsharded run, so the same
+    ``--check`` verdict applies; the CLI prelude sets
+    ``--xla_force_host_platform_device_count`` before jax loads."""
     trace = load_trace(trace_path)
     requests = trace["requests"]
     if not include_errors:
@@ -1140,7 +1174,12 @@ def run_replay(trace_path: str, limit: int = 0,
         raise ValueError(f"{trace_path}: no replayable requests")
     meta = trace["meta"]
     page = int(meta.get("page_size", 16))
-    engine = build_replay_engine(meta, requests, model_size=model_size)
+    base_serving = None
+    if tp > 1:
+        from deepspeed_tpu.inference.v2 import ServingOptimizationConfig
+        base_serving = ServingOptimizationConfig(tp_degree=tp)
+    engine = build_replay_engine(meta, requests, model_size=model_size,
+                                 serving=base_serving)
     vocab = min(int(meta.get("vocab_size", 0))
                 or engine.model.cfg.vocab_size,
                 engine.model.cfg.vocab_size)
@@ -1156,11 +1195,13 @@ def run_replay(trace_path: str, limit: int = 0,
     out = {"trace": trace_path, "meta": meta,
            "requests": len(requests),
            "recorded_compiles": len(trace["compiles"]),
+           "tp": int(max(tp, 1)),
            "replay": report, "diff": verdict}
     if spec:
         from deepspeed_tpu.inference.v2 import ServingOptimizationConfig
         spec_serving = ServingOptimizationConfig(speculative=True,
-                                                 spec_drafter=drafter)
+                                                 spec_drafter=drafter,
+                                                 tp_degree=tp)
         if drafter == "ngram":
             # same engine: the n-gram drafter is host-side state only
             spec_engine = engine
@@ -1239,6 +1280,12 @@ def main(argv=None) -> int:
                     "model/auto rebuild the spec engine with the "
                     "in-program draft head and the report splits "
                     "accept rate per drafter")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="shard the replay engine over an N-way "
+                    "simulated tensor-parallel mesh (ISSUE 18); the "
+                    "prelude forces N host devices before jax loads, "
+                    "and --check additionally requires zero on-path "
+                    "compiles and zero structured errors")
     ap.add_argument("--disagg", action="store_true",
                     help="replay through the two-pool disaggregated "
                     "prefill/decode scheduler (ISSUE 13): committed-"
@@ -1271,6 +1318,9 @@ def main(argv=None) -> int:
     ap.add_argument("--json", default="",
                     help="also write the full report to this path")
     args = ap.parse_args(argv)
+    if args.tp > 1 and (args.tier or args.disagg):
+        ap.error("--tp shards the base/--spec replay only; the tier "
+                 "and disagg legs build their own engines")
 
     try:
         if args.tier:
@@ -1296,7 +1346,7 @@ def main(argv=None) -> int:
                              model_size=args.model_size,
                              seed=args.seed, warmup=not args.no_warmup,
                              tolerance=args.tolerance, spec=args.spec,
-                             drafter=args.drafter)
+                             drafter=args.drafter, tp=args.tp)
     except ValueError as e:
         print(f"replay_trace: {e}", file=sys.stderr)
         return 1
@@ -1311,6 +1361,20 @@ def main(argv=None) -> int:
         problems.append(
             f"[disagg] {out['replay']['lost']} request(s) lost "
             "(neither completed nor structurally errored)")
+    if args.tp > 1 and not (args.tier or args.disagg):
+        # the sharded leg is a STRONGER contract than base structural
+        # parity: the one-program step must come entirely out of the
+        # warmed shape set (tp in the compile-cache digest — a mesh
+        # change is a MISS, never a wrong executable), and sharding may
+        # not surface as per-request structured errors
+        if out["replay"].get("compile_on_path"):
+            problems.append(
+                f"[tp] {out['replay']['compile_on_path']} on-path "
+                "compile(s) during the sharded measured replay")
+        if out["replay"].get("errors"):
+            problems.append(
+                f"[tp] {len(out['replay']['errors'])} structured "
+                "error(s) during the sharded replay")
     if args.spec and not out["spec"]["diff"]["structural_ok"]:
         # the spec pass must reproduce the same structure — speculation
         # may only change throughput/metrics
