@@ -61,6 +61,7 @@ from ...runtime.fault_injection import (InjectedPreemptionFault,
                                         PoisonedRequestFault,
                                         get_fault_injector)
 from ...telemetry import get_tracer, trace_span
+from ...telemetry import journey as _journey
 from ...telemetry import metrics as tm
 from ...telemetry.flight_recorder import get_flight_recorder
 from ...telemetry.state import state as _telemetry
@@ -136,6 +137,14 @@ class Request:
     #: at the one-shot prefix lookup (the sequence may be flushed
     #: before the trace-finish point); None = no lookup / all-cold
     tier_hits: Optional[dict] = None
+    #: request journey (ISSUE 19): the end-to-end segment log this
+    #: request carries across routers/pools/handoffs/migrations
+    #: (telemetry.journey.Journey); None = journeys off at submit.
+    #: ``journey_admitted`` latches the per-scheduler queue_wait mark —
+    #: a migrated resubmission is a NEW scheduler Request sharing the
+    #: SAME journey object, and queues again on the survivor
+    journey: Optional[object] = None
+    journey_admitted: bool = False
 
     @property
     def prefill_remaining(self) -> int:
@@ -427,7 +436,26 @@ class FastGenScheduler:
             hit_device=(req.tier_hits or {}).get("device", 0),
             hit_host=(req.tier_hits or {}).get("host", 0),
             hit_disk=(req.tier_hits or {}).get("disk", 0),
-            hit_remote=(req.tier_hits or {}).get("remote", 0))
+            hit_remote=(req.tier_hits or {}).get("remote", 0),
+            journey_ms=(req.journey.bucket_ms()
+                        if req.journey is not None
+                        and req.journey.segments else None))
+
+    # -- request journeys (ISSUE 19): flush at drain/error -------------------
+    def _journey_finish(self, req: Request, outcome: str) -> None:
+        """Close and publish the request's journey (exactly once —
+        :meth:`telemetry.journey.JourneyLog.publish` is idempotent
+        through the ``closed`` latch, so a prefill-side copy whose
+        request finished on the decode pool never double-flushes)."""
+        j = req.journey
+        if j is None or j.closed:
+            return
+        if req.generated:
+            # first_token -> last committed token; a request that died
+            # before any token folds straight into drain
+            j.mark("decode")
+        j.mark("drain")
+        _journey.get_journey_log().publish(j, outcome)
 
     def _trace_token(self, req: Request) -> None:
         """Stamp one host-visible token (capture-on path only)."""
@@ -439,7 +467,9 @@ class FastGenScheduler:
     # -- request lifecycle ---------------------------------------------------
     def submit(self, uid: int, prompt: Sequence[int],
                params: Optional[SamplingParams] = None,
-               ttl_s: Optional[float] = None) -> Optional[RequestError]:
+               ttl_s: Optional[float] = None,
+               journey: Optional[object] = None
+               ) -> Optional[RequestError]:
         """Queue a request; returns None on acceptance or the
         structured :class:`RequestError` verdict on immediate
         rejection (also recorded in :attr:`errors`).  ``ttl_s`` (or the
@@ -448,10 +478,16 @@ class FastGenScheduler:
         of hanging.  A bounded admission queue (``max_queue_depth``), a
         violated queue-wait SLO (``shed_queue_wait_ms``), or a closed
         scheduler (drain-for-snapshot/shutdown, code="closing") rejects
-        the request immediately."""
+        the request immediately.  ``journey`` is the caller's existing
+        request journey (ISSUE 19: a pool minted it at ITS submit and
+        keeps appending placement/migration segments to the same
+        object); without one, a fresh journey is minted here — the
+        request-scoped trace context every boundary propagates."""
         req = Request(
             uid=uid, prompt=np.asarray(prompt, dtype=np.int32),
             params=params or SamplingParams())
+        req.journey = journey if journey is not None \
+            else _journey.mint(uid)
         now = time.monotonic()
         req.submit_mono = now
         if self._closed:
@@ -576,6 +612,9 @@ class FastGenScheduler:
         get_flight_recorder().record(
             "request.error", uid=req.uid, code=code,
             message=message[:200], tokens=len(req.generated))
+        # journey flush precedes the ledger record so the ledger's
+        # journey_<bucket>_ms fields see the closed chain
+        self._journey_finish(req, code)
         if self._wtrace.active:
             # error point of the workload ledger: the outcome code IS
             # the structured error code
@@ -697,6 +736,13 @@ class FastGenScheduler:
             self._note_token_slo(req)
         if self._wtrace.active:
             self._trace_token(req)
+        if req.journey is not None and len(req.generated) == 1:
+            # the first committed token closes prefill; first_token
+            # itself is the (~0 ms) delivery instant.  Handoff-imported
+            # requests arrive with generated tokens, so these segments
+            # are marked exactly once, on the prefill side
+            req.journey.mark("prefill")
+            req.journey.mark("first_token")
         out[req.uid] = tok
         if on_token is not None:
             on_token(req.uid, tok)
@@ -715,6 +761,7 @@ class FastGenScheduler:
         self._running.pop(req.uid, None)
         if self._drafter is not None:
             self._drafter.drop(req.uid)
+        self._journey_finish(req, "ok")
         if self._wtrace.active:
             self._trace_finish(req, "ok")
 
@@ -1345,6 +1392,12 @@ class FastGenScheduler:
         if hit:
             req.prompt_sent = hit
             req.tier_hits = self._engine.tier_hits(req.uid)
+            if req.journey is not None and any(
+                    (req.tier_hits or {}).get(t)
+                    for t in ("host", "disk", "remote")):
+                # a cross-tier promotion paid wall time here; device
+                # cache hits are reference attaches and stay unmarked
+                req.journey.mark("tier_promote")
             # attached pages that counted as schedulable in this
             # admission's snapshot and are now live must be charged:
             # parked->live transitions (device cache hits) AND
@@ -1475,6 +1528,13 @@ class FastGenScheduler:
                         _faults.fire("fastgen.poison_request"):
                     raise PoisonedRequestFault(
                         f"injected poisoned request {req.uid}")
+                if req.journey is not None and not req.journey_admitted:
+                    # first admission attempt on THIS scheduler closes
+                    # queue_wait, so the prefix match / tier promotion
+                    # below gets its own segment instead of inheriting
+                    # the queue time
+                    req.journey_admitted = True
+                    req.journey.mark("queue_wait")
                 if is_new and self._prefix_cfg and not req.prefix_checked:
                     with trace_span("fastgen.prefix_match"):
                         self._match_prefix_once(req, adm)
@@ -1702,6 +1762,16 @@ class FastGenScheduler:
             raise ValueError(
                 f"export_handoff of non-handoff-ready uids {missing}")
         now = time.monotonic()
+        for u in uids:
+            req = self._handoff_ready[u]
+            if req.journey is not None:
+                # the journey travels WHOLE inside the bundle (via
+                # _serialize_request below); the fragment keeps the
+                # exporting side's view reconstructable even if the
+                # importer dies mid-transfer
+                req.journey.mark("handoff_export")
+                _journey.get_journey_log().publish_fragment(
+                    req.journey, where=self._role or "prefill")
         eng_meta, arrays = self._engine.state_manager.export_state(
             seq_ids=list(uids))
         meta = {
@@ -1756,6 +1826,7 @@ class FastGenScheduler:
                 raise SnapshotError(
                     f"import_handoff: uid {uid} already live on the "
                     "importing scheduler")
+        t_import = time.time()     # transfer ends where import begins
         with trace_span("fastgen.import_handoff"):
             stats = self._engine.state_manager.import_state(
                 meta["engine"], arrays)
@@ -1763,6 +1834,16 @@ class FastGenScheduler:
             uids: List[int] = []
             for d in meta["requests"]:
                 req = self._restore_request(d, now)
+                if req.journey is not None:
+                    # split the window since handoff_export: the wire/
+                    # queue time, then the page-merge + restore work.
+                    # at= is the IMPORTING scheduler's role — the pump
+                    # thread driving this import carries the exporter's
+                    # component label
+                    at = self._role or "decode"
+                    req.journey.mark("handoff_transfer", at=at,
+                                     t=t_import)
+                    req.journey.mark("handoff_import", at=at)
                 sd = self._engine.state_manager.get_sequence(req.uid)
                 if sd is not None and sd.host_blob is not None:
                     # handed off mid-preemption: resumes through the
@@ -1938,7 +2019,13 @@ class FastGenScheduler:
                     "ngram": [int(req.spec_drafted_ngram),
                               int(req.spec_accepted_ngram)],
                     "model": [int(req.spec_drafted_model),
-                              int(req.spec_accepted_model)]}}
+                              int(req.spec_accepted_model)]},
+                # journey (ISSUE 19): the segment log rides every
+                # bundle a request can cross — handoff, snapshot,
+                # migration — so the importer appends to the context
+                # it received, not a fresh one
+                "journey": (req.journey.to_dict()
+                            if req.journey is not None else None)}
 
     def _restore_request(self, d: dict, now: float) -> Request:
         pr = d["params"]
@@ -1977,6 +2064,12 @@ class FastGenScheduler:
         if ttl is not None:
             req.deadline = now + float(ttl)
             self._has_deadlines = True
+        jd = d.get("journey")
+        if jd:
+            # legacy bundles (no journey) restore without one — every
+            # touch point is None-gated, so the request just stops
+            # contributing segments
+            req.journey = _journey.Journey.from_dict(jd)
         return req
 
     def snapshot(self, path: Optional[str] = None,
@@ -2143,6 +2236,17 @@ class FastGenScheduler:
             self._handoff_ready = {
                 int(d["uid"]): self._restore_request(d, now)
                 for d in reqs.get("handoff_ready", [])}
+            # journey (ISSUE 19): the wall time between snapshot and
+            # restore IS the migration — close it as one "migrate"
+            # segment here (not in _restore_request: the handoff-import
+            # path uses that helper too and marks its own transfer/
+            # import split) so reconstructed chains stay gap-free
+            # across the outage
+            for req in (self._pending + list(self._running.values())
+                        + list(self._preempted.values())
+                        + list(self._handoff_ready.values())):
+                if req.journey is not None:
+                    req.journey.mark("migrate")
             c = meta["counters"]
             self._step_ordinal = int(c["step_ordinal"])
             self.last_step_scheduled = int(c["last_step_scheduled"])

@@ -64,8 +64,10 @@ import numpy as np
 from ..inference.v2.ragged.blocked_allocator import KVAllocationError
 from ..inference.v2.sampling import SamplingParams
 from ..inference.v2.scheduler import FastGenScheduler, RequestError
+from ..telemetry import journey as _journey
 from ..telemetry import metrics as tm
 from ..telemetry.flight_recorder import get_flight_recorder
+from ..telemetry.tracer import set_component
 from .pool import PoolRequest
 
 #: deferred-import attempts against a BUSY decode pool before the pool
@@ -192,6 +194,12 @@ class DisaggPool:
                           prompt=np.asarray(prompt, dtype=np.int32),
                           params=params, replica="prefill")
         req.submit_mono = time.monotonic()
+        req.journey = _journey.mint(uid)
+        if req.journey is not None:
+            # disagg placement is static (everything enters prefill),
+            # but the segment still closes submit -> admission handed
+            # to the prefill scheduler, mirroring the pool's router leg
+            req.journey.mark("placement", at="router")
         if ttl_s:
             req.deadline = req.submit_mono + float(ttl_s)
         with self._lock:
@@ -201,7 +209,8 @@ class DisaggPool:
             self._requests[uid] = req
         with self._plock:
             verdict = self.prefill.submit(uid, req.prompt, params,
-                                          ttl_s=ttl_s)
+                                          ttl_s=ttl_s,
+                                          journey=req.journey)
         if verdict is not None:
             req.error = RequestError(uid=uid, code=verdict.code,
                                      message=verdict.message,
@@ -357,6 +366,7 @@ class DisaggPool:
 
     # -- stepping ------------------------------------------------------------
     def _step_prefill(self) -> bool:
+        set_component("prefill")
         with self._plock:
             if not self.prefill.has_work:
                 return False
@@ -366,6 +376,7 @@ class DisaggPool:
             return True
 
     def _step_decode(self) -> bool:
+        set_component("decode")
         with self._dlock:
             if not self.decode.has_work:
                 return False
@@ -442,6 +453,7 @@ class DisaggPool:
             t.start()
 
     def _prefill_loop(self) -> None:
+        set_component("prefill")
         while not self._stop_evt.is_set():
             stepped = self._step_prefill()
             if self._pump_due(stepped):
@@ -453,6 +465,7 @@ class DisaggPool:
                 time.sleep(self._pace_s)
 
     def _decode_loop(self) -> None:
+        set_component("decode")
         while not self._stop_evt.is_set():
             stepped = self._step_decode()
             if not stepped:

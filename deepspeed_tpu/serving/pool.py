@@ -67,8 +67,10 @@ import numpy as np
 from ..inference.v2.sampling import SamplingParams
 from ..inference.v2.scheduler import FastGenScheduler, RequestError
 from ..runtime.fault_injection import InjectedPreemptionFault
+from ..telemetry import journey as _journey
 from ..telemetry import metrics as tm
 from ..telemetry.flight_recorder import get_flight_recorder
+from ..telemetry.tracer import set_component
 from .router import PrefixAffinityRouter, RouteDecision
 
 
@@ -93,6 +95,11 @@ class PoolRequest:
     #: absolute monotonic deadline (None = no TTL); survives migration
     #: as a remaining budget
     deadline: Optional[float] = None
+    #: journey (ISSUE 19): ONE trace context for the request's whole
+    #: life — every scheduler Request it is (re)submitted as shares
+    #: this same object, so segments from before and after a migration
+    #: land in one chain
+    journey: Optional[object] = None
 
     @property
     def finalized(self) -> bool:
@@ -300,11 +307,16 @@ class ReplicaPool:
             tm.POOL_AFFINITY_ROUTED.inc()
         req.replica = decision.label
         req.matched_pages = decision.matched_pages
+        if req.journey is not None:
+            req.journey.mark("placement", at="router")
         if decision.fetch_from:
             self._fetch_pages(rep, decision)
+            if req.journey is not None:
+                req.journey.mark("page_fetch", at=decision.label)
         with rep.lock:
             verdict = rep.scheduler.submit(req.uid, prompt, params,
-                                           ttl_s=ttl_s)
+                                           ttl_s=ttl_s,
+                                           journey=req.journey)
         if verdict is not None:
             req.error = RequestError(uid=req.uid, code=verdict.code,
                                      message=verdict.message,
@@ -366,6 +378,7 @@ class ReplicaPool:
                           prompt=np.asarray(prompt, dtype=np.int32),
                           params=params)
         req.submit_mono = time.monotonic()
+        req.journey = _journey.mint(uid)
         if ttl_s:
             req.deadline = req.submit_mono + float(ttl_s)
         with self._lock:
@@ -398,6 +411,7 @@ class ReplicaPool:
         preemption fault escaping the step kills the replica like a
         preempted spot VM; the pool absorbs it."""
         died = publish = False
+        set_component(rep.label)
         with rep.lock:
             if not rep.alive or not rep.scheduler.has_work:
                 return False
@@ -471,6 +485,7 @@ class ReplicaPool:
                 t.start()
 
     def _thread_loop(self, rep: _Replica) -> None:
+        set_component(rep.label)
         while not self._stop_evt.is_set() and rep.alive:
             if not self._step_replica(rep):
                 time.sleep(0.002)
@@ -511,6 +526,12 @@ class ReplicaPool:
                     and req.tokens[-1] == stop)):
             req.done = True       # finished exactly at the boundary
             req.finished_mono = req.finished_mono or time.monotonic()
+            # the dead home never got to flush this journey (it
+            # finished AT the migration boundary, with no survivor
+            # scheduler to close it) — the pool is the only owner left
+            if req.journey is not None:
+                req.journey.mark("decode")
+                _journey.get_journey_log().publish(req.journey, "ok")
             return
         prompt2 = (np.concatenate(
             [req.prompt, np.asarray(req.tokens, np.int32)])
@@ -522,6 +543,10 @@ class ReplicaPool:
                if req.deadline is not None else None)
         req.migrations += 1
         tm.POOL_MIGRATED.inc()
+        # close the outage window (death/drain -> re-home) as one
+        # "migrate" segment before the new home starts queue_wait
+        if req.journey is not None:
+            req.journey.mark("migrate")
         self._place(req, prompt2, params2, ttl, exclude=exclude)
 
     def scale_down(self, label: Optional[str] = None) -> Optional[str]:

@@ -48,6 +48,8 @@ from .federation import (Federation,  # noqa: F401
 from .federation import \
     maybe_configure_from_env as _federation_from_env
 from .slo import SLOEvaluator, get_slo_evaluator  # noqa: F401
+from .journey import (Journey, JourneyLog,  # noqa: F401
+                      get_journey_log)
 from .server import serve_registry  # noqa: F401
 
 

@@ -16,6 +16,11 @@ plus, when workload capture is enabled (ISSUE 9), a sixth artifact:
 - ``workload.jsonl`` — the tail of the live workload-trace ledger, so
   a crash ships the traffic that caused it alongside the forensics,
 
+when any request journeys were recorded (ISSUE 19):
+
+- ``journeys.json`` — the journey log's tail of completed journeys
+  and exported fragments (the per-request segment chains),
+
 and, when the time-series sampler is running (ISSUE 11), a seventh:
 
 - ``timeseries.json`` — the sampled metric ring: the minutes BEFORE
@@ -63,6 +68,7 @@ EVENT_KINDS = frozenset({
     "disagg.build", "disagg.handoff", "disagg.handoff_ready",
     "engine.build", "engine.destroy",
     "fastgen.reopen", "fastgen.restore", "fastgen.snapshot",
+    "journey.flush", "journey.fragment",
     "kv.alloc_fail", "kv.demote", "kv.evict", "kv.promote",
     "pool.advice_applied", "pool.build", "pool.page_fetch",
     "pool.rebalance",
@@ -125,9 +131,14 @@ class FlightRecorder:
         attribute read, no allocation."""
         if not state.enabled:
             return
-        from .tracer import get_tracer
+        from .tracer import current_component, get_tracer
         evt = {"ts": time.time(), "kind": event,
                "step": get_tracer().step}
+        comp = current_component()
+        if comp:
+            # satellite (ISSUE 19): pool stepper threads interleave in
+            # one process ring — label which replica/component spoke
+            evt["component"] = comp
         evt.update(fields)
         with self._lock:
             self._events.append(evt)
@@ -205,6 +216,13 @@ class FlightRecorder:
             with open(path, "w") as f:
                 f.write(tail)
             paths["workload.jsonl"] = path
+        # journeys.json (ISSUE 19): the journey log's tail of completed
+        # journeys + exported fragments — on/off with capture exactly
+        # like the ledger artifact (skipped when nothing was recorded)
+        from .journey import get_journey_log
+        jdoc = get_journey_log().tail_json()
+        if jdoc is not None:
+            write("journeys.json", jdoc)
         # seventh artifact (ISSUE 11): the time-series ring — only when
         # the sampler is configured and has samples, so forensics get
         # the minutes BEFORE the crash (windowed rates, gauge

@@ -7,7 +7,7 @@ registry against docs/DESIGN.md's metric table in tier-1.
 
 Naming convention: ``ds_<area>_<name>`` with area one of
 {serving, comm, kv, train, fastgen, chaos, fleet, slo, telemetry,
-pool, disagg};
+pool, disagg, journey};
 counters end in ``_total``.
 """
 
@@ -389,6 +389,20 @@ DISAGG_DECODE_HBM_GB_S = registry.gauge(
     "ds_disagg_decode_hbm_gb_s",
     "decode pool HBM traffic rate (GB/s of bytes accessed) over its "
     "cost window")
+
+# -- request journeys (ISSUE 19) ----------------------------------------------
+JOURNEY_FLUSHED = registry.counter(
+    "ds_journey_flushed_total",
+    "completed request journeys published to the journey log at "
+    "drain/error (one per request, on its final scheduler)")
+JOURNEY_FRAGMENTS = registry.counter(
+    "ds_journey_fragments_total",
+    "journey fragments exported at a pool/process boundary (handoff "
+    "export) — a fragment whose jid never completes is an orphan")
+JOURNEY_SEGMENT_MS = registry.histogram(
+    "ds_journey_segment_ms",
+    "duration of one typed journey segment (queue_wait, placement, "
+    "prefill, handoff_*, migrate, decode, ...), observed at flush")
 
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(

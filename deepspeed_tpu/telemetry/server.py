@@ -15,6 +15,10 @@ config's ``telemetry.metrics_port``).  Serves:
 - ``/fleet``    — the federation's merged ``ds_fleet_*`` view over the
   configured replica targets (text; ``?json=1`` for JSON)
 - ``/trace``    — current span ring buffer as Chrome-trace JSON
+- ``/journey``  — ``?uid=<uid>`` returns this process's completed
+  journey records and exported fragments for that request (ISSUE 19);
+  a cross-process stitcher (``tools/fleetctl.py journey``) scrapes
+  every replica's endpoint and merges chains by journey id
 - ``/healthz``  — watchdog verdicts + SLO burn-rate verdicts + uptime;
   HTTP 200 while healthy, 503 on a non-finite / anomaly-storm /
   SLO-page verdict so a fleet health checker needs no JSON parsing
@@ -87,6 +91,15 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             body = json.dumps({
                 "traceEvents": get_tracer().chrome_events(),
                 "displayTimeUnit": "ms"}).encode()
+            ctype = "application/json"
+        elif path == "/journey":
+            from .journey import get_journey_log
+            try:
+                uid = int(params["uid"][0])
+            except (KeyError, IndexError, ValueError):
+                self.send_error(400, "journey lookup needs ?uid=<int>")
+                return
+            body = json.dumps(get_journey_log().lookup(uid)).encode()
             ctype = "application/json"
         elif path == "/healthz":
             from .watchdog import get_watchdog
@@ -214,7 +227,7 @@ def start_http_server(port: int,
     tm.TELEMETRY_PORT.set(bound)
     from ..utils.logging import logger
     logger.info("telemetry: metrics endpoint on %s:%d "
-                "(/metrics /snapshot /fleet /trace /healthz)",
+                "(/metrics /snapshot /fleet /trace /journey /healthz)",
                 addr, bound)
     return srv
 

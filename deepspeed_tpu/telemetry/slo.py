@@ -269,6 +269,14 @@ class SLOEvaluator:
                  "value": self._value(o, ts, fast),
                  "advice": advice,
                  "windows_s": [o["fast_window_s"], o["slow_window_s"]]}
+            if status == "page" and o["kind"] == "latency":
+                # journey attribution (ISSUE 19): name the segment
+                # dominating the slowest completed journeys, so the
+                # page reads "latency, dominated by handoff_transfer"
+                # instead of just "latency"
+                dom = self._dominant_segment()
+                if dom is not None:
+                    v["dominant_segment"] = dom
             verdicts.append(v)
             worst = max(worst, SEVERITY[status])
             if fast is not None:
@@ -292,18 +300,26 @@ class SLOEvaluator:
             tm.SLO_WARNS.inc()
         # "objective_kind", not "kind": the flight recorder reserves
         # "kind" for the event type itself
+        dom = verdict.get("dominant_segment")
         self._record("slo.verdict", objective=o["name"],
                      objective_kind=o["kind"], prev=prev, status=status,
                      fast_burn=verdict["fast_burn"],
                      slow_burn=verdict["slow_burn"],
                      value=verdict["value"],
-                     advice=verdict["advice"])
+                     advice=verdict["advice"],
+                     **({"dominant_segment": dom["seg"],
+                         "dominant_share": dom["share"]}
+                        if dom else {}))
         if status == "page":
+            attribution = (f"; dominated by {dom['seg']} "
+                           f"({dom['share']:.0%} of slow-decile "
+                           "journey time)" if dom else "")
             self._record("slo.advice", action=o["advice"],
                          objective=o["name"],
                          reason=f"burn {verdict['fast_burn']} over "
                                 f"{o['fast_window_s']}s window "
-                                f"(page at {o['page_burn']})")
+                                f"(page at {o['page_burn']})"
+                                + attribution)
         if SEVERITY[status] >= SEVERITY["warn"]:
             self._logger().warning(
                 "slo: objective %r %s -> %s (fast burn %s, slow burn "
@@ -345,6 +361,13 @@ class SLOEvaluator:
             "status": {0: "ok", 1: "warn", 2: "page"}[worst],
             "objectives": verdicts,
         }
+
+    @staticmethod
+    def _dominant_segment() -> Optional[Dict[str, Any]]:
+        """Which journey segment dominates the slowest completed
+        decile (ISSUE 19) — None when no journeys have flushed."""
+        from .journey import get_journey_log
+        return get_journey_log().dominant_segment()
 
     @staticmethod
     def _record(event: str, **fields) -> None:

@@ -25,6 +25,23 @@ from .state import state
 #: record = (name, start_s, dur_s, step, thread_id, attrs-or-None)
 Record = Tuple[str, float, float, int, int, Optional[Dict[str, Any]]]
 
+#: thread-local replica/component label (ISSUE 19 satellite): pool
+#: stepper threads interleave anonymously in the one process-wide span
+#: ring — a component label on each record (and on flight events, and
+#: on journey segments' ``at``) tells the replicas apart in Perfetto
+#: and in stitched journeys
+_COMPONENT = threading.local()
+
+
+def set_component(label: str) -> None:
+    """Label every span/flight-event/journey-segment this thread
+    records from now on (e.g. ``r0``, ``prefill``, ``decode``)."""
+    _COMPONENT.value = str(label)
+
+
+def current_component() -> str:
+    return getattr(_COMPONENT, "value", "")
+
 def _default_capacity() -> int:
     """``DS_TRACE_BUFFER`` is a tuning knob, not a correctness switch —
     a malformed value (``64k``) must not kill every ``import
@@ -66,6 +83,10 @@ class SpanTracer:
 
     def record(self, name: str, start: float, dur: float,
                attrs: Optional[Dict[str, Any]] = None) -> None:
+        comp = getattr(_COMPONENT, "value", "")
+        if comp:
+            # merged, not mutated: the caller's attrs dict may be shared
+            attrs = {"component": comp, **(attrs or {})}
         rec = (name, start, dur, self.step,
                threading.get_ident(), attrs)
         with self._lock:

@@ -187,7 +187,9 @@ class WorkloadTrace:
                        hit_device: int = 0,
                        hit_host: int = 0,
                        hit_disk: int = 0,
-                       hit_remote: int = 0) -> None:
+                       hit_remote: int = 0,
+                       journey_ms: Optional[Dict[str, float]] = None
+                       ) -> None:
         """One terminated request (scheduler drain/error point).  Only
         lengths, digests, params, latencies and speculation counts —
         never token ids.  ``spec_drafted``/``spec_accepted`` are this
@@ -203,7 +205,12 @@ class WorkloadTrace:
         ``hit_device``/``hit_host``/``hit_disk``/``hit_remote`` are the
         request's warm-prefix tokens by tier of origin (ISSUE 16) — the
         analyzer's tier-hit report sizes the host/disk tiers from
-        them."""
+        them.
+        ``journey_ms`` is the request's journey-bucket decomposition
+        (ISSUE 19: {queue, placement, prefill, handoff, promote,
+        decode, migrate} -> ms), written out as the flattened scalar
+        ``journey_<bucket>_ms`` fields — absent entirely on journeys-
+        off runs, which analyze_trace notes and degrades on."""
         if not self.active:
             return
         rec = {
@@ -236,6 +243,10 @@ class WorkloadTrace:
             "hit_disk": int(hit_disk),
             "hit_remote": int(hit_remote),
         }
+        if journey_ms:
+            # flattened scalars too (same audit rule as the spec splits)
+            for bucket, ms in journey_ms.items():
+                rec[f"journey_{bucket}_ms"] = round(float(ms), 3)
         with self._lock:
             if not self.active:
                 return

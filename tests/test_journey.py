@@ -1,0 +1,483 @@
+"""Request journeys (ISSUE 19): end-to-end per-request tracing.
+
+Unit layer: the partition-of-wall-time invariants (``mark`` chains are
+contiguous and sum to the end-to-end time BY CONSTRUCTION), bundle
+round-trips, gap detection, cross-process stitching, orphan
+accounting, dominant-segment attribution, and the one-attribute-read
+disabled path.  Integration layer: the single scheduler flushes a
+gap-free chain whose segments sum to the measured e2e; the disagg
+pools record the export/transfer/import split plus a prefill-side
+fragment with zero orphans; a mid-run replica kill shows up as a
+``migrate`` segment (and a second ``queue_wait``) in a COMPLETED
+journey; the ledger's flattened ``journey_<bucket>_ms`` scalars feed
+``analyze_trace``'s journeys report; the ``/journey`` endpoint serves
+per-uid lookups.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.inference.v2 import (
+    FastGenScheduler, InferenceEngineV2, KVCacheConfig,
+    RaggedInferenceEngineConfig, RaggedInferenceModel, SamplingParams,
+    ServingOptimizationConfig, StateManagerConfig)
+from deepspeed_tpu.models.llama import LlamaForCausalLM
+from deepspeed_tpu.serving import DisaggPool, ReplicaPool
+from deepspeed_tpu.telemetry import journey as jn
+from deepspeed_tpu.telemetry import metrics as tm
+
+PAGE = 16
+
+
+@pytest.fixture(autouse=True)
+def _journeys_on():
+    """Every test starts with telemetry on (journeys ride the global
+    enable) and a clean journey log; leaves both reset."""
+    jn.get_journey_log().clear()
+    telemetry.enable()
+    yield
+    telemetry.disable()
+    jn.get_journey_log().clear()
+
+
+# ---------------------------------------------------------------------------
+# unit: the Journey partition invariants
+# ---------------------------------------------------------------------------
+
+class TestJourneyUnit:
+    def test_disabled_path_is_one_attribute_read(self):
+        telemetry.disable()
+        assert jn.mint(1) is None
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            jn.mint(1)
+        per_call = (time.perf_counter() - t0) / n
+        # the ISSUE 19 budget: under 5 microseconds per disabled mint
+        assert per_call < 5e-6, f"disabled mint costs {per_call*1e6:.2f}us"
+
+    def test_mint_stamps_uid_and_unique_jids(self):
+        a, b = jn.mint(7), jn.mint(7)
+        assert a is not None and b is not None
+        assert a.uid == 7 and b.uid == 7
+        assert a.jid != b.jid          # resubmits/restores reuse uids
+
+    def test_marks_partition_wall_time(self):
+        j = jn.Journey("j-1", 1, t0=100.0)
+        j.mark("queue_wait", at="r0", t=100.010)
+        j.mark("prefill", t=100.110)
+        j.mark("decode", t=100.510)
+        rec = j.to_dict()
+        assert [s["seg"] for s in rec["segments"]] == \
+            ["queue_wait", "prefill", "decode"]
+        # contiguous by construction: each segment starts at the
+        # previous segment's end, the first at t0
+        assert jn.chain_gaps(rec) == []
+        assert j.total_ms() == pytest.approx(
+            sum(s["ms"] for s in j.segments))
+        assert j.total_ms() == pytest.approx(510.0, abs=1e-6)
+
+    def test_past_stamp_clamps_without_breaking_the_chain(self):
+        j = jn.Journey("j-2", 2, t0=100.0)
+        j.mark("prefill", t=100.100)
+        # a wall-clock step backwards (NTP slew, cross-process skew)
+        # records a zero-length segment, never a negative one, and the
+        # chain stays contiguous
+        j.mark("handoff_export", t=100.050)
+        assert j.segments[-1]["ms"] == 0.0
+        j.mark("decode", t=100.200)
+        assert jn.chain_gaps(j.to_dict()) == []
+        assert j.total_ms() == pytest.approx(200.0, abs=1e-6)
+
+    def test_bucket_rollup_covers_every_bucket(self):
+        j = jn.Journey("j-3", 3, t0=0.0)
+        stamps = [("placement", 0.001), ("queue_wait", 0.003),
+                  ("prefill", 0.013), ("first_token", 0.013),
+                  ("handoff_export", 0.014), ("handoff_transfer", 0.024),
+                  ("handoff_import", 0.027), ("decode", 0.127),
+                  ("drain", 0.128)]
+        for seg, t in stamps:
+            j.mark(seg, t=t)
+        b = j.bucket_ms()
+        assert set(b) == set(jn.BUCKET_NAMES)
+        assert b["placement"] == pytest.approx(1.0, abs=1e-3)
+        assert b["queue"] == pytest.approx(2.0, abs=1e-3)
+        assert b["prefill"] == pytest.approx(10.0, abs=1e-3)
+        assert b["handoff"] == pytest.approx(14.0, abs=1e-3)
+        assert b["decode"] == pytest.approx(101.0, abs=1e-3)
+        assert b["migrate"] == 0.0 and b["promote"] == 0.0
+        assert sum(b.values()) == pytest.approx(j.total_ms(), abs=1e-2)
+        # every producer-markable kind has a bucket
+        assert set(jn.SEGMENT_KINDS) == set(jn.BUCKETS)
+
+    def test_dict_round_trip_continues_the_chain(self):
+        j = jn.Journey("j-4", 4, t0=50.0)
+        j.mark("prefill", at="prefill", t=50.2)
+        back = jn.Journey.from_dict(j.to_dict())
+        assert back.jid == j.jid and back.uid == 4
+        assert back.segments == pytest.approx(j.segments) or \
+            back.segments[0]["ms"] == pytest.approx(
+                j.segments[0]["ms"], abs=1e-3)
+        # the importer keeps marking into the SAME timeline: the next
+        # segment starts exactly where the exporter's chain ended
+        back.mark("handoff_import", t=50.35)
+        assert jn.chain_gaps(back.to_dict()) == []
+
+    def test_chain_gaps_flags_a_discontinuity(self):
+        j = jn.Journey("j-5", 5, t0=10.0)
+        j.mark("prefill", t=10.1)
+        rec = j.to_dict()
+        rec["segments"].append({"seg": "decode", "t0": 10.2,
+                                "ms": 5.0, "at": ""})   # 100ms hole
+        gaps = jn.chain_gaps(rec)
+        assert len(gaps) == 1 and "decode" in gaps[0]
+        assert jn.chain_gaps(rec, eps_ms=200.0) == []
+
+
+# ---------------------------------------------------------------------------
+# unit: JourneyLog (publish, fragments, orphans, attribution)
+# ---------------------------------------------------------------------------
+
+class TestJourneyLog:
+    def _journey(self, uid, seg="decode", ms=10.0, t0=0.0):
+        j = jn.Journey(f"u{uid}", uid, t0=t0)
+        j.mark(seg, t=t0 + ms / 1e3)
+        return j
+
+    def test_publish_is_idempotent_through_the_closed_latch(self):
+        log = jn.get_journey_log()
+        j = self._journey(1)
+        before = tm.JOURNEY_FLUSHED.value
+        log.publish(j, "ok")
+        log.publish(j, "ok")            # a migration copy re-flushes
+        assert tm.JOURNEY_FLUSHED.value == before + 1
+        assert len(log.completed()) == 1
+        assert log.completed()[0]["outcome"] == "ok"
+        j2 = self._journey(2)
+        j2.closed = True                # already flushed elsewhere
+        log.publish(j2, "ok")
+        assert len(log.completed()) == 1
+
+    def test_closed_journey_refuses_marks(self):
+        log = jn.get_journey_log()
+        j = self._journey(3)
+        log.publish(j, "ok")
+        n = len(j.segments)
+        j.mark("decode")
+        assert len(j.segments) == n
+
+    def test_fragment_without_completion_is_an_orphan(self):
+        log = jn.get_journey_log()
+        lost, done = self._journey(10), self._journey(11)
+        log.publish_fragment(lost, where="prefill")
+        log.publish_fragment(done, where="prefill")
+        log.publish(done, "ok")
+        assert log.orphans() == [lost.jid]
+        look = log.lookup(10)
+        assert look["completed"] == [] and len(look["fragments"]) == 1
+        assert look["fragments"][0]["where"] == "prefill"
+
+    def test_stitch_dedups_the_fragment_prefix(self):
+        j = jn.Journey("x-1", 9, t0=0.0)
+        j.mark("prefill", at="prefill", t=0.1)
+        frag = j.to_dict()
+        frag["where"] = "prefill"       # the exporter's partial view
+        j.mark("handoff_transfer", at="decode", t=0.15)
+        j.mark("decode", at="decode", t=0.55)
+        comp = j.to_dict()
+        comp["outcome"] = "ok"
+        st = jn.stitch([frag, comp])
+        assert st["jid"] == "x-1" and st["sources"] == 2
+        assert st["outcome"] == "ok"
+        assert [s["seg"] for s in st["segments"]] == \
+            ["prefill", "handoff_transfer", "decode"]
+        assert jn.chain_gaps(st) == []
+
+    def test_dominant_segment_survives_tied_totals(self):
+        log = jn.get_journey_log()
+        # two records with IDENTICAL totals: the sort must break the
+        # tie on the index, never compare the record dicts
+        for uid in (1, 2):
+            log.publish(self._journey(uid, "decode", ms=10.0), "ok")
+        dom = log.dominant_segment(top_frac=1.0)
+        assert dom is not None and dom["seg"] == "decode"
+
+    def test_dominant_segment_attributes_the_slow_decile(self):
+        log = jn.get_journey_log()
+        for uid in range(18):
+            log.publish(self._journey(uid, "decode", ms=10.0), "ok")
+        for uid in (100, 101):          # the slow tail waits on handoff
+            j = jn.Journey(f"s{uid}", uid, t0=0.0)
+            j.mark("handoff_transfer", t=0.5)
+            j.mark("decode", t=0.6)
+            log.publish(j, "ok")
+        dom = log.dominant_segment(top_frac=0.1)
+        assert dom["seg"] == "handoff_transfer"
+        assert dom["slow_journeys"] == 2 and dom["share"] > 0.5
+
+    def test_tail_json_and_capacity_bound(self):
+        log = jn.JourneyLog(capacity=4)
+        assert log.tail_json() is None
+        for uid in range(8):
+            log.publish(self._journey(uid), "ok")
+        tail = log.tail_json()
+        assert len(tail["completed"]) == 4      # bounded ring
+        assert [r["uid"] for r in tail["completed"]] == [4, 5, 6, 7]
+
+
+# ---------------------------------------------------------------------------
+# integration: engines
+# ---------------------------------------------------------------------------
+
+_PARAMS_CACHE = {}
+
+
+def _model_parts():
+    if not _PARAMS_CACHE:
+        model_def = LlamaForCausalLM("debug", max_seq_len=256,
+                                     dtype=jnp.float32)
+        _PARAMS_CACHE["cfg"] = model_def.cfg
+        _PARAMS_CACHE["params"] = meta.unbox(
+            model_def.init_params(jax.random.key(0)))
+    return _PARAMS_CACHE["cfg"], _PARAMS_CACHE["params"]
+
+
+def _engine(serving=None, num_pages=96, max_seqs=8):
+    cfg, params = _model_parts()
+    kv_cfg = KVCacheConfig(num_layers=cfg.num_layers,
+                           kv_heads=cfg.kv_heads,
+                           head_dim=cfg.dims_per_head, page_size=PAGE,
+                           num_pages=num_pages, dtype=jnp.float32)
+    model = RaggedInferenceModel(cfg, params, kv_config=kv_cfg)
+    econf = RaggedInferenceEngineConfig(
+        state_manager=StateManagerConfig(
+            max_tracked_sequences=max_seqs,
+            max_ragged_sequence_count=max_seqs,
+            max_ragged_batch_size=256))
+    if serving is not None:
+        econf.serving = serving
+    return InferenceEngineV2(model, econf)
+
+
+def _prompt(seed, n=40):
+    return ((np.arange(n) * 7 + seed * 131 + 3) % 97).astype(np.int32)
+
+
+GREEDY6 = SamplingParams(max_new_tokens=6, temperature=0.0)
+
+
+def _completed_for(uid):
+    recs = [r for r in jn.get_journey_log().completed()
+            if r["uid"] == uid]
+    assert recs, f"no flushed journey for uid {uid}"
+    return recs[-1]
+
+
+def _assert_sums_to(rec, e2e_ms, slack_ms=75.0):
+    seg_ms = sum(s["ms"] for s in rec["segments"])
+    assert abs(seg_ms - e2e_ms) <= max(slack_ms, 0.10 * e2e_ms), \
+        f"journey {seg_ms:.1f}ms vs measured e2e {e2e_ms:.1f}ms"
+
+
+class TestSchedulerJourney:
+    def test_single_scheduler_flushes_gap_free_sum_to_e2e(self):
+        sched = FastGenScheduler(_engine())
+        t_submit = {}
+        for uid in range(3):
+            t_submit[uid] = time.time()
+            sched.submit(uid, _prompt(uid), GREEDY6)
+        sched.run_to_completion()
+        t_done = time.time()
+        for uid in range(3):
+            rec = _completed_for(uid)
+            assert rec["outcome"] == "ok"
+            segs = [s["seg"] for s in rec["segments"]]
+            for want in ("queue_wait", "prefill", "first_token",
+                         "decode", "drain"):
+                assert want in segs, f"uid {uid} missing {want}: {segs}"
+            assert jn.chain_gaps(rec, eps_ms=5.0) == []
+            _assert_sums_to(rec, (t_done - t_submit[uid]) * 1e3)
+
+    def test_ledger_carries_the_flattened_decomposition(self, tmp_path):
+        from deepspeed_tpu.telemetry import get_workload_trace
+        wt = get_workload_trace()
+        path = str(tmp_path / "trace.jsonl")
+        wt.configure(path)
+        try:
+            sched = FastGenScheduler(_engine())
+            for uid in range(3):
+                sched.submit(uid, _prompt(uid), GREEDY6)
+            sched.run_to_completion()
+        finally:
+            wt.close()
+        with open(path) as f:
+            reqs = [json.loads(line) for line in f]
+        reqs = [r for r in reqs if r.get("kind") == "request"]
+        assert len(reqs) == 3
+        for r in reqs:
+            # flattened scalars, one per bucket, no list-shaped fields
+            for b in jn.BUCKET_NAMES:
+                assert isinstance(r[f"journey_{b}_ms"], float)
+            jsum = sum(r[f"journey_{b}_ms"] for b in jn.BUCKET_NAMES)
+            assert jsum > 0.0
+            rec = _completed_for(r["uid"])
+            assert jsum == pytest.approx(
+                sum(s["ms"] for s in rec["segments"]), abs=0.1)
+
+    def test_journeys_off_is_invisible(self):
+        telemetry.disable()
+        sched = FastGenScheduler(_engine())
+        sched.submit(1, _prompt(1), GREEDY6)
+        assert sched._pending[0].journey is None
+        sched.run_to_completion()
+        assert jn.get_journey_log().completed() == []
+
+
+class TestDisaggJourney:
+    def test_handoff_split_fragment_and_zero_orphans(self):
+        pool = DisaggPool(
+            lambda: FastGenScheduler(_engine(
+                ServingOptimizationConfig(role="prefill",
+                                          keyed_sampling=True))),
+            lambda: FastGenScheduler(_engine(
+                ServingOptimizationConfig(role="decode",
+                                          keyed_sampling=True))),
+            handoff_every=1)
+        for uid in range(2):
+            pool.submit(uid, _prompt(uid), GREEDY6)
+        pool.run_to_completion()
+        assert not pool.errors
+        log = jn.get_journey_log()
+        assert log.orphans() == []      # every fragment completed
+        frags = log.fragments()
+        assert len(frags) == 2
+        assert all(f["where"] == "prefill" for f in frags)
+        for uid in range(2):
+            rec = _completed_for(uid)
+            segs = [s["seg"] for s in rec["segments"]]
+            # the handoff is split at the instant the bundle arrived:
+            # export (prefill side) -> transfer -> import (decode side)
+            for want in ("handoff_export", "handoff_transfer",
+                         "handoff_import"):
+                assert want in segs, f"uid {uid}: {segs}"
+            assert segs.index("handoff_export") \
+                < segs.index("handoff_transfer") \
+                < segs.index("handoff_import") < segs.index("drain")
+            by = {s["seg"]: s for s in rec["segments"]}
+            assert by["handoff_import"]["at"] == "decode"
+            assert jn.chain_gaps(rec, eps_ms=5.0) == []
+
+
+class TestPoolMigrationJourney:
+    def test_mid_run_kill_writes_a_migrate_segment(self):
+        engines = {}
+
+        def factory(label):
+            if label not in engines:
+                engines[label] = _engine()
+            return FastGenScheduler(engines[label])
+
+        pool = ReplicaPool(factory, replicas=2)
+        for uid in range(4):
+            pool.submit(uid, _prompt(uid),
+                        SamplingParams(max_new_tokens=8,
+                                       temperature=0.0))
+        for _ in range(2):
+            pool.step()
+        victims = [u for u in range(4)
+                   if pool.request(u).replica == pool.labels[0]]
+        assert victims                  # both replicas got traffic
+        pool.kill(pool.labels[0])
+        got = pool.run_to_completion()
+        assert not pool.errors and len(got) == 4
+        for uid in victims:
+            rec = _completed_for(uid)
+            segs = [s["seg"] for s in rec["segments"]]
+            assert "migrate" in segs, f"uid {uid}: {segs}"
+            # the survivor's admission queues the SAME journey again
+            assert segs.count("queue_wait") == 2
+            assert jn.chain_gaps(rec, eps_ms=5.0) == []
+        assert jn.get_journey_log().orphans() == []
+
+
+# ---------------------------------------------------------------------------
+# tools: analyze_trace journeys report + the /journey endpoint
+# ---------------------------------------------------------------------------
+
+def _trace_requests(n, journeys=True):
+    reqs = []
+    for i in range(n):
+        r = {"kind": "request", "uid": i, "arrival_s": i * 0.01,
+             "prompt_len": 8, "gen_len": 4, "outcome": "ok",
+             "ttft_ms": 20.0, "itl_ms": 5.0, "queue_wait_ms": 1.0}
+        if journeys:
+            slow = i >= n - 2           # the tail waits on handoff
+            r.update({f"journey_{b}_ms": 0.0 for b in jn.BUCKET_NAMES})
+            r.update(journey_queue_ms=1.0, journey_prefill_ms=20.0,
+                     journey_decode_ms=15.0,
+                     journey_handoff_ms=500.0 if slow else 2.0)
+        reqs.append(r)
+    return {"meta": {"page_size": PAGE, "vocab_size": 128},
+            "requests": reqs, "compiles": [], "key_counts": {}}
+
+
+class TestAnalyzeJourneys:
+    def test_report_attributes_the_slow_decile(self):
+        from tools.analyze_trace import analyze
+        out = analyze(_trace_requests(20))
+        j = out["journeys"]
+        assert j["requests_with_journeys"] == 20
+        assert j["note"] is None
+        assert j["per_bucket_ms"]["prefill"]["p50"] == 20.0
+        assert j["per_bucket_ms"]["handoff"]["p99"] > 100.0
+        dom = j["slowest_decile_dominant"]
+        assert dom["bucket"] == "handoff" and dom["slow_requests"] == 2
+        assert dom["share"] > 0.5
+
+    def test_legacy_trace_notes_and_degrades(self):
+        from tools.analyze_trace import analyze
+        out = analyze(_trace_requests(8, journeys=False))
+        j = out["journeys"]
+        assert j["requests_with_journeys"] == 0
+        assert j["per_bucket_ms"] is None
+        assert j["slowest_decile_dominant"] is None
+        assert "no journey decomposition" in j["note"]
+
+
+class TestJourneyEndpoint:
+    def test_lookup_served_and_bad_uid_is_400(self):
+        from deepspeed_tpu.telemetry import (start_http_server,
+                                             stop_http_server)
+        log = jn.get_journey_log()
+        j = jn.Journey("e-1", 42, t0=0.0)
+        j.mark("decode", t=0.1)
+        log.publish_fragment(j, where="prefill")
+        log.publish(j, "ok")
+        srv = start_http_server(0)
+        try:
+            base = f"http://127.0.0.1:{srv.server_address[1]}"
+            body = json.loads(urllib.request.urlopen(
+                f"{base}/journey?uid=42").read())
+            assert body["uid"] == 42
+            assert len(body["completed"]) == 1
+            assert len(body["fragments"]) == 1
+            assert body["completed"][0]["jid"] == "e-1"
+            empty = json.loads(urllib.request.urlopen(
+                f"{base}/journey?uid=7").read())
+            assert empty == {"uid": 7, "completed": [],
+                             "fragments": []}
+            for bad in ("/journey", "/journey?uid=abc"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(base + bad)
+                assert ei.value.code == 400
+        finally:
+            stop_http_server()

@@ -535,14 +535,24 @@ class TestPostmortemArtifact:
         lines = [json.loads(l)
                  for l in open(out / "workload.jsonl") if l.strip()]
         assert sum(1 for l in lines if l["kind"] == "request") == 4
+        # the run flushed journeys too (telemetry was on at submit), so
+        # the bundle ships them alongside the ledger tail (ISSUE 19)
+        assert "journeys.json" in paths
+        jdoc = json.loads(open(out / "journeys.json").read())
+        assert len(jdoc["completed"]) >= 4
 
     def test_bundle_without_capture_stays_five_artifacts(self, tmp_path,
                                                          monkeypatch):
         from deepspeed_tpu import telemetry
+        from deepspeed_tpu.telemetry import journey
         assert not get_workload_trace().active
+        # journeys.json follows the same skip-when-empty rule as the
+        # ledger tail (ISSUE 19) — a journey-free process ships neither
+        journey.get_journey_log().clear()
         monkeypatch.setattr(telemetry.state, "enabled", True)
         paths = telemetry.dump_postmortem(str(tmp_path / "pm5"))
         assert "workload.jsonl" not in paths
+        assert "journeys.json" not in paths
         assert len(paths) == 5
 
 

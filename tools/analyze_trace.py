@@ -15,6 +15,10 @@ From a JSONL ledger captured by ``telemetry/workload_trace.py``:
   (``inference.v2.engine.lattice_keys`` — the same enumeration
   ``precompile()`` compiles, so this report can't drift from the live
   path) against the observed keys;
+- a **journeys report** (ISSUE 19): per-segment p50/p99 of the
+  flattened ``journey_<bucket>_ms`` TTFT-decomposition scalars, plus
+  dominant-segment attribution for the slowest decile (legacy traces
+  note-and-degrade);
 - a **recommended bucket lattice**: quantile-fitted Q/P boundaries
   (bucket tops placed on the observed length distribution instead of
   fixed powers, bounded per-bucket overshoot) plus a recommended
@@ -264,6 +268,44 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
                  "prefix caching / kv_tier_* were off"),
     }
 
+    # -- journey mining (ISSUE 19): the flattened journey_<bucket>_ms
+    # TTFT-decomposition scalars the scheduler flushes at drain make
+    # per-segment latency minable from the same ledger — where did the
+    # slowest requests actually spend their time? -----------------------------
+    jfields = ("queue", "placement", "prefill", "handoff", "promote",
+               "decode", "migrate")
+    jreqs = [r for r in requests if r.get("journey_queue_ms") is not None]
+    per_bucket = {}
+    for b in jfields:
+        vals = [float(r.get(f"journey_{b}_ms", 0.0)) for r in jreqs]
+        per_bucket[b] = {"p50": _pct(vals, 50), "p99": _pct(vals, 99)}
+    dominant = None
+    if jreqs:
+        # dominant-segment attribution for the slowest decile (by
+        # summed journey time — the e2e latency by construction)
+        totals = sorted(
+            (sum(float(r.get(f"journey_{b}_ms", 0.0)) for b in jfields),
+             i) for i, r in enumerate(jreqs))
+        n = max(1, len(totals) // 10)
+        slow = [jreqs[i] for _, i in totals[-n:]]
+        by_b = {b: sum(float(r.get(f"journey_{b}_ms", 0.0))
+                       for r in slow) for b in jfields}
+        total = sum(by_b.values())
+        if total > 0:
+            seg = max(by_b, key=by_b.get)
+            dominant = {"bucket": seg,
+                        "share": round(by_b[seg] / total, 4),
+                        "slow_requests": len(slow)}
+    journeys = {
+        "requests_with_journeys": len(jreqs),
+        "per_bucket_ms": per_bucket if jreqs else None,
+        "slowest_decile_dominant": dominant,
+        "note": (None if jreqs else
+                 "no journey decomposition in this trace — captured "
+                 "before the journey_<bucket>_ms ledger fields "
+                 "existed, or telemetry was off at capture"),
+    }
+
     return {
         "meta": {k: v for k, v in meta.items() if k != "kind"},
         "requests": {
@@ -297,6 +339,7 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
         },
         "speculation": speculation,
         "tiers": tiers,
+        "journeys": journeys,
         "recommended_lattice": {
             "page_size": page,
             "s_buckets": s_buckets,

@@ -56,6 +56,12 @@
 #                      two-pool prefill/decode scheduler with
 #                      committed-page KV streaming handoffs; asserts
 #                      structural parity AND zero lost requests
+#   5d. journey smoke — tools/replay_trace.py --disagg --journeys
+#                      --check (ISSUE 19): the same 32 requests with
+#                      request journeys on; asserts every completed
+#                      request reconstructs a GAP-FREE segment chain
+#                      whose segments sum to its measured e2e latency,
+#                      and that zero handoff fragments were orphaned
 #   5c. cold-start smoke — tools/coldstart_smoke.py --check
 #                      (ISSUE 14): process A mines a lattice artifact
 #                      from the checked-in trace, precompiles it into
@@ -116,6 +122,10 @@ python tools/fleetctl.py --pool-smoke
 echo "== disaggregated two-pool smoke (KV-streaming handoffs) =="
 python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
     --limit 32 --disagg --check > /dev/null
+
+echo "== request-journey smoke (gap-free chains, 0 orphans) =="
+python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
+    --limit 32 --disagg --journeys --check > /dev/null
 
 echo "== cold-start smoke (persistent compile cache + auto lattice) =="
 python tools/coldstart_smoke.py --check --limit 16 > /dev/null

@@ -16,6 +16,11 @@ Usage::
 
     python tools/fleetctl.py --targets 127.0.0.1:9001,127.0.0.1:9002
         [status|json|metrics|digests] [--watch SECONDS]
+    python tools/fleetctl.py --targets ... journey <uid>
+                                           # scrape every replica's
+                                           # /journey?uid= records and
+                                           # stitch one cross-process
+                                           # segment chain (ISSUE 19)
     python tools/fleetctl.py --smoke       # CI: two debug replicas,
                                            # merged counters == sum
     python tools/fleetctl.py --kill-demo   # bench: two replicas, one
@@ -547,6 +552,47 @@ def run_pool_demo(limit: int = 24, pace_s: float = 0.01,
     }
 
 
+def _journey_text(targets: List[Tuple[str, str]], uid: int) -> str:
+    """Cross-process journey reconstruction (ISSUE 19): scrape every
+    target's ``/journey?uid=`` records and stitch them into one
+    chronological segment chain by journey id — the "explain a slow
+    request" runbook's fleet view.  ``targets`` are (label, host:port)
+    pairs; unreachable replicas degrade to a line, never an abort."""
+    import urllib.request
+    from deepspeed_tpu.telemetry import journey as jn
+    records: List[Dict[str, Any]] = []
+    lines = []
+    for label, target in targets:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{target}/journey?uid={int(uid)}",
+                    timeout=5) as resp:
+                doc = json.loads(resp.read().decode())
+        except Exception as e:  # noqa: BLE001 — any replica may be down
+            lines.append(f"{label:<8} UNREACHABLE ({e})")
+            continue
+        comp, frag = doc.get("completed", []), doc.get("fragments", [])
+        lines.append(f"{label:<8} {len(comp)} completed, "
+                     f"{len(frag)} fragment(s)")
+        records.extend(comp + frag)
+    if not records:
+        lines.append(f"uid {uid}: no journey records on any target "
+                     "(telemetry off, or the rings rolled over)")
+        return "\n".join(lines)
+    stitched = jn.stitch(records)
+    total = sum(s["ms"] for s in stitched["segments"])
+    lines.append(f"journey {stitched['jid']} uid={uid} "
+                 f"outcome={stitched.get('outcome')} "
+                 f"sources={stitched['sources']} "
+                 f"total={round(total, 2)}ms")
+    for s in stitched["segments"]:
+        at = f" @{s['at']}" if s.get("at") else ""
+        lines.append(f"  {s['seg']:<16} {s['ms']:>10.3f} ms{at}")
+    for finding in jn.chain_gaps(stitched, eps_ms=5.0):
+        lines.append(f"  GAP: {finding}")
+    return "\n".join(lines)
+
+
 def _digests_text(targets: List[Tuple[str, str]], top_k: int = 8) -> str:
     """Per-target ``/snapshot?digests=1`` affinity hints (the
     subprocess-mode router input, ISSUE 12).  ``targets`` are
@@ -585,7 +631,11 @@ def _status_text(view: Dict[str, Any]) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("command", nargs="?", default="status",
-                    choices=["status", "json", "metrics", "digests"])
+                    choices=["status", "json", "metrics", "digests",
+                             "journey"])
+    ap.add_argument("uid", nargs="?", type=int,
+                    help="journey command: the request uid to stitch "
+                    "across the fleet")
     ap.add_argument("--targets", default="",
                     help="comma-separated [label=]host:port replica "
                     "list (or DS_FLEET_TARGETS)")
@@ -639,13 +689,21 @@ def main(argv=None) -> int:
     from deepspeed_tpu.telemetry.federation import Federation
     fed = Federation()
     fed.configure_targets(targets)
-    if args.command == "digests":
+    if args.command in ("digests", "journey"):
         pairs = []
         for i, entry in enumerate(t.strip() for t in
                                   targets.split(",") if t.strip()):
             label, _, tgt = (entry.partition("=") if "=" in entry
                              else (f"r{i}", "", entry))
             pairs.append((label.strip(), tgt.strip()))
+        if args.command == "journey":
+            if args.uid is None:
+                print("fleetctl: journey needs a uid "
+                      "(fleetctl --targets ... journey <uid>)",
+                      file=sys.stderr)
+                return 2
+            print(_journey_text(pairs, args.uid))
+            return 0
         while True:
             print(_digests_text(pairs))
             if not args.watch:

@@ -389,7 +389,8 @@ def replay_disagg(prefill_engine, decode_engine,
                   prompts: List[np.ndarray],
                   speed: float = 0.0,
                   threaded: bool = False,
-                  on_token=None) -> Dict[str, Any]:
+                  on_token=None,
+                  journeys: bool = False) -> Dict[str, Any]:
     """Re-issue the trace through a fresh :class:`DisaggPool` over the
     two prebuilt engines (ISSUE 13).  Same submission/pacing contract
     and report shape as :func:`replay`, so ``diff_replay`` diffs both
@@ -401,7 +402,12 @@ def replay_disagg(prefill_engine, decode_engine,
     CI smoke asserts 0).  ``threaded`` drives the pool through its
     ``start()`` stepper threads so the two pools genuinely overlap
     (the bench mode; keyed sampling keeps token values deterministic
-    regardless of thread interleaving)."""
+    regardless of thread interleaving).  ``journeys`` (ISSUE 19)
+    enables telemetry for the measured run and verifies request
+    journeys end-to-end: every completed request must reconstruct a
+    gap-free segment chain that sums to its measured e2e latency, with
+    zero orphaned handoff fragments — findings land in the report's
+    ``journeys`` block (and in ``--check`` problems)."""
     from deepspeed_tpu.inference.v2 import (FastGenScheduler,
                                             SamplingParams)
     from deepspeed_tpu.serving import DisaggPool
@@ -441,6 +447,19 @@ def replay_disagg(prefill_engine, decode_engine,
     share0 = tm.DISAGG_PAGES_SHARED.value
     handoff_ms: List[float] = []
     pool._on_handoff_ms = handoff_ms.append
+
+    jlog = prev_enabled = None
+    if journeys:
+        # journeys gate on the telemetry switch (mint() is the
+        # disabled-path read); enable for the measured window only and
+        # start from an empty log so the verdicts below see exactly
+        # this run
+        import deepspeed_tpu.telemetry as dstel
+        from deepspeed_tpu.telemetry import journey as dsjourney
+        jlog = dsjourney.get_journey_log()
+        jlog.clear()
+        prev_enabled = dstel.enabled()
+        dstel.enable()
 
     nxt = 0
     stalls = 0
@@ -485,6 +504,9 @@ def replay_disagg(prefill_engine, decode_engine,
         finally:
             if threaded:
                 pool.stop()
+            if journeys:
+                import deepspeed_tpu.telemetry as dstel
+                dstel.set_enabled(bool(prev_enabled))
     # per-pool cost over each pool's BUSY window (seconds inside its
     # own scheduler steps): the specialization claim is about what a
     # role-shrunk program mix does with the hardware while it runs,
@@ -497,6 +519,43 @@ def replay_disagg(prefill_engine, decode_engine,
              for i in submitted if i in first_t]
     lost = [i for i in submitted
             if not pool.request(i).finalized]
+
+    journeys_report = None
+    if journeys:
+        from deepspeed_tpu.telemetry import journey as dsjourney
+        completed = {r["uid"]: r for r in jlog.completed()}
+        jproblems: List[str] = []
+        for i in submitted:
+            preq = pool.request(i)
+            if preq is None or not preq.done:
+                continue
+            rec = completed.get(i)
+            if rec is None:
+                jproblems.append(f"uid {i}: completed request has no "
+                                 "flushed journey")
+                continue
+            for g in dsjourney.chain_gaps(rec, eps_ms=5.0):
+                jproblems.append(f"uid {i}: {g}")
+            e2e_ms = (preq.finished_mono - preq.submit_mono) * 1e3
+            seg_ms = sum(s["ms"] for s in rec["segments"])
+            # ε: the drain mark fires on the scheduler's finish sweep,
+            # up to one step after the pool ledger saw the last token
+            if abs(seg_ms - e2e_ms) > max(75.0, 0.10 * e2e_ms):
+                jproblems.append(
+                    f"uid {i}: journey segments sum "
+                    f"{round(seg_ms, 1)}ms vs measured e2e "
+                    f"{round(e2e_ms, 1)}ms")
+        orphans = jlog.orphans()
+        if orphans:
+            jproblems.append(f"{len(orphans)} orphaned journey "
+                             f"fragment(s): {orphans[:4]}")
+        journeys_report = {
+            "completed_journeys": len(completed),
+            "fragments": len(jlog.fragments()),
+            "orphans": len(orphans),
+            "problems": jproblems,
+        }
+
     return {
         "requests_submitted": len(submitted),
         "submit_order": submitted,
@@ -525,6 +584,7 @@ def replay_disagg(prefill_engine, decode_engine,
         "decode_busy_s": round(pool.decode_busy_s, 4),
         "programs_prefill": len(prefill_engine.model._step_cache),
         "programs_decode": len(decode_engine.model._step_cache),
+        "journeys": journeys_report,
     }
 
 
@@ -532,7 +592,8 @@ def run_replay_disagg(trace_path: str, limit: int = 0,
                       include_errors: bool = False, speed: float = 0.0,
                       model_size: str = "debug", seed: int = 0,
                       warmup: bool = True, tolerance: float = 4.0,
-                      keyed: bool = True) -> Dict[str, Any]:
+                      keyed: bool = True,
+                      journeys: bool = False) -> Dict[str, Any]:
     """load → synthesize → (shape-warmup) → measured two-pool replay →
     structural diff: the disagg counterpart of :func:`run_replay`,
     behind the CI disagg smoke and bench.py's BENCH_DISAGG leg."""
@@ -558,7 +619,7 @@ def run_replay_disagg(trace_path: str, limit: int = 0,
         _reset_engine(pre_eng)
         _reset_engine(dec_eng)
     report = replay_disagg(pre_eng, dec_eng, requests, prompts,
-                           speed=speed)
+                           speed=speed, journeys=journeys)
     verdict = diff_replay(requests, prompts, page, report,
                           tolerance=tolerance)
     return {"trace": trace_path, "meta": meta,
@@ -1309,6 +1370,13 @@ def main(argv=None) -> int:
     ap.add_argument("--tier-disk-pages", type=int, default=256,
                     help="disk tier capacity for --tier (0 disables "
                     "the disk tier and its spill check)")
+    ap.add_argument("--journeys", action="store_true",
+                    help="with --disagg: enable telemetry for the "
+                    "measured run and verify request journeys (ISSUE "
+                    "19) — every completed request must reconstruct a "
+                    "gap-free segment chain summing to its measured "
+                    "e2e latency, with zero orphaned handoff "
+                    "fragments; --check fails on any finding")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the untimed shape-warmup pass (the "
                     "measured run then eats the XLA compiles)")
@@ -1321,6 +1389,9 @@ def main(argv=None) -> int:
     if args.tp > 1 and (args.tier or args.disagg):
         ap.error("--tp shards the base/--spec replay only; the tier "
                  "and disagg legs build their own engines")
+    if args.journeys and not args.disagg:
+        ap.error("--journeys rides the --disagg leg (the journey "
+                 "smoke verifies the handoff segments)")
 
     try:
         if args.tier:
@@ -1338,7 +1409,7 @@ def main(argv=None) -> int:
                 include_errors=args.include_errors,
                 speed=args.speed, model_size=args.model_size,
                 seed=args.seed, warmup=not args.no_warmup,
-                tolerance=args.tolerance)
+                tolerance=args.tolerance, journeys=args.journeys)
         else:
             out = run_replay(args.trace, limit=args.limit,
                              include_errors=args.include_errors,
@@ -1361,6 +1432,12 @@ def main(argv=None) -> int:
         problems.append(
             f"[disagg] {out['replay']['lost']} request(s) lost "
             "(neither completed nor structurally errored)")
+    if args.journeys:
+        jrep = out["replay"].get("journeys") or {}
+        problems += [f"[journey] {p}" for p in jrep.get("problems", ())]
+        if not jrep.get("completed_journeys"):
+            problems.append("[journey] no journeys flushed during the "
+                            "measured replay")
     if args.tp > 1 and not (args.tier or args.disagg):
         # the sharded leg is a STRONGER contract than base structural
         # parity: the one-program step must come entirely out of the
