@@ -358,6 +358,8 @@ class InferenceEngineV2:
         self._config.telemetry.apply()
         self._config.fault_injection.apply()
         self._bind_kv_gauges()
+        self._pages_dist_cache = None
+        self._bind_memory_accountants()
         # flight recorder (ISSUE 5): capture the serving config + a
         # lifecycle event at engine build
         from ...telemetry.flight_recorder import get_flight_recorder
@@ -481,6 +483,149 @@ class InferenceEngineV2:
 
         tm.KV_TIER_HOST_PAGES.bind(tier_read("host_pages"))
         tm.KV_TIER_DISK_PAGES.bind(tier_read("disk_pages"))
+
+    @staticmethod
+    def _params_resident_bytes(params) -> int:
+        """This process's resident weight bytes: the sum of addressable
+        shard footprints (the per-shard slice under tensor parallelism;
+        a replicated or unsharded leaf reports its full nbytes)."""
+        total = 0
+        for leaf in jax.tree.leaves(params):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                total += sum(int(s.data.nbytes) for s in shards)
+            else:
+                total += int(getattr(leaf, "nbytes", 0))
+        return total
+
+    def _bind_memory_accountants(self) -> None:
+        """Register this engine's subsystems with the memory ledger
+        (ISSUE 20) — the same weakref/newest-owner discipline as the
+        ``ds_kv_*`` gauges.  Weights and pool footprints are computed
+        once here (both are fixed post-build); tier/offload accountants
+        read the live manager."""
+        from ...telemetry.memory import get_memory_ledger
+        led = get_memory_ledger()
+        wbytes = self._params_resident_bytes(self._model.params)
+        led.register_object("weights", self, lambda e, b=wbytes: b)
+        kv_bytes = self._model.kv_config.total_bytes()
+        led.register_object("kv_pages", self._state,
+                            lambda st, b=kv_bytes: b)
+        draft_bytes = (int(self._draft_kv.nbytes)
+                       if self._draft_kv is not None else 0)
+        led.register_object("draft_kv", self,
+                            lambda e, b=draft_bytes: b)
+        led.register_object(
+            "tier_host", self._state,
+            lambda st: getattr(getattr(st, "tiers", None),
+                               "host_bytes", 0) or 0)
+        led.register_object(
+            "tier_disk", self._state,
+            lambda st: getattr(getattr(st, "tiers", None),
+                               "disk_bytes", 0) or 0)
+        led.register_object("offload", self._state,
+                            lambda st: st.offloaded_blob_bytes)
+        # headroom gauge (ISSUE 20): admissible sequences at the
+        # observed per-seq page distribution; sampled into the
+        # time-series ring so a `capacity` SLO objective can burn on it
+        import weakref
+        ref = weakref.ref(self)
+
+        def _headroom_seqs(r=ref):
+            eng = r()
+            if eng is None:
+                return 0
+            return eng.headroom()["headroom_seqs"]
+
+        tm.MEM_HEADROOM_SEQS.bind(_headroom_seqs)
+
+    # -- headroom model (ISSUE 20) -------------------------------------------
+    def headroom(self) -> Dict:
+        """How many MORE sequences fit right now: free + parked (and
+        tier-demotable) pages divided by the observed p90
+        pages-per-sequence, additionally capped by free tracked-
+        sequence slots.  The per-seq distribution is mined from the
+        workload ledger when capture is on, from live sequences
+        otherwise, with a documented 512-token assumption as the cold
+        default."""
+        alloc = self._state.kv_cache.allocator
+        free = int(alloc.free_pages)
+        parked = int(alloc.parked_pages)
+        tiers = getattr(self._state, "tiers", None)
+        demotable = 0
+        if tiers is not None:
+            spare = max(tiers._host_cap - tiers.host_pages, 0)
+            if tiers._disk_cap:
+                spare += max(tiers._disk_cap - tiers.disk_pages, 0)
+            demotable = min(parked, spare)
+        pages = free + parked
+        p50, p90, basis = self._pages_per_seq_estimate()
+        sm = self._config.state_manager
+        slots = max(int(sm.max_tracked_sequences)
+                    - self._state.n_tracked_sequences, 0)
+        seqs = min(pages // max(p90, 1), slots)
+        return {
+            "free_pages": free,
+            "parked_pages": parked,
+            "demotable_pages": demotable,
+            "headroom_pages": pages,
+            "slot_headroom": slots,
+            "pages_per_seq_p50": p50,
+            "pages_per_seq_p90": p90,
+            "basis": basis,
+            "headroom_seqs": max(int(seqs), 0),
+        }
+
+    def _pages_per_seq_estimate(self) -> Tuple[int, int, str]:
+        """(p50, p90, basis) of pages needed per sequence.  Mined from
+        the workload ledger's request tail ("trace"), else the live
+        pool's pages-per-tracked-sequence ("live"), else a 512-token
+        assumption ("default").  Cached ~10s: the ledger tail is a file
+        read and headroom rides every time-series sample."""
+        import time as _time
+        now = _time.monotonic()
+        cached = self._pages_dist_cache
+        if cached is not None and now < cached[0]:
+            return cached[1], cached[2], cached[3]
+        page = int(self._model.kv_config.page_size)
+        p50 = p90 = 0
+        basis = "default"
+        try:
+            from ...telemetry.workload_trace import get_workload_trace
+            tail = get_workload_trace().tail_text()
+        except Exception:
+            tail = None
+        if tail:
+            import json as _json
+            lens = []
+            for line in tail.splitlines()[-1024:]:
+                try:
+                    rec = _json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") != "request":
+                    continue
+                toks = (int(rec.get("prompt_len", 0))
+                        + int(rec.get("gen_len", 0)))
+                if toks > 0:
+                    lens.append(-(-toks // page))
+            if lens:
+                lens.sort()
+                p50 = lens[len(lens) // 2]
+                p90 = lens[min(int(len(lens) * 0.9),
+                               len(lens) - 1)]
+                basis = "trace"
+        if not p90:
+            alloc = self._state.kv_cache.allocator
+            n = self._state.n_tracked_sequences
+            if n > 0 and alloc.live_pages > 0:
+                p50 = p90 = -(-int(alloc.live_pages) // n)
+                basis = "live"
+        if not p90:
+            p50 = p90 = max(-(-512 // page), 1)
+            basis = "default"
+        self._pages_dist_cache = (now + 10.0, p50, p90, basis)
+        return p50, p90, basis
 
     def precompile(self, max_prompt: int, max_concurrency: int = 0,
                    max_new_tokens: int = 256,

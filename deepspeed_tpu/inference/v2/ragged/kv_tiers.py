@@ -50,14 +50,23 @@ class _DiskMeta:
     """Host-side record of one on-disk page entry (shapes/dtypes never
     persist — the store is per-process, like the device cache)."""
 
-    __slots__ = ("path", "shape", "dtype", "scale_shape", "scale_dtype")
+    __slots__ = ("path", "shape", "dtype", "scale_shape", "scale_dtype",
+                 "nbytes")
 
-    def __init__(self, path, shape, dtype, scale_shape, scale_dtype):
+    def __init__(self, path, shape, dtype, scale_shape, scale_dtype,
+                 nbytes):
         self.path = path
         self.shape = shape
         self.dtype = dtype
         self.scale_shape = scale_shape
         self.scale_dtype = scale_dtype
+        self.nbytes = nbytes
+
+
+def _blob_nbytes(blob) -> int:
+    """Byte footprint of one page blob (ndarray or quantized
+    :class:`PageBlob` — both expose ``nbytes``)."""
+    return int(getattr(blob, "nbytes", 0))
 
 
 class TieredPageStore:
@@ -69,12 +78,21 @@ class TieredPageStore:
     """
 
     def __init__(self, host_pages: int, disk_pages: int = 0,
-                 disk_dir: Optional[str] = None) -> None:
+                 disk_dir: Optional[str] = None,
+                 bytes_per_page: int = 0) -> None:
         if host_pages < 1:
             raise ValueError(
                 f"tier host ring needs >= 1 page, got {host_pages}")
         self._host_cap = int(host_pages)
         self._disk_cap = max(0, int(disk_pages))
+        # byte-audited disk bound (ISSUE 20 bugfix): the page-count cap
+        # alone never audited FILE bytes, so oversized entries (or a
+        # bytes_per_page drift) could hold unbounded disk; with a known
+        # page footprint the disk tier is bounded in BYTES too
+        self._bytes_per_page = max(0, int(bytes_per_page))
+        self._disk_bytes_cap = self._disk_cap * self._bytes_per_page
+        self._host_bytes = 0
+        self._disk_bytes = 0
         #: digest -> blob, LRU order (oldest first)
         self._host: "OrderedDict[bytes, object]" = OrderedDict()
         #: digest -> _DiskMeta, LRU order (oldest first)
@@ -109,6 +127,17 @@ class TieredPageStore:
     @property
     def disk_pages(self) -> int:
         return len(self._disk)
+
+    @property
+    def host_bytes(self) -> int:
+        """Bytes resident in the host DRAM ring (ledger accountant)."""
+        return self._host_bytes
+
+    @property
+    def disk_bytes(self) -> int:
+        """Bytes held as disk tier files (ledger accountant; audited
+        against the ``kv_tier_disk_pages`` byte bound)."""
+        return self._disk_bytes
 
     @property
     def inflight_pages(self) -> int:
@@ -192,14 +221,27 @@ class TieredPageStore:
             tm.KV_TIER_IO_ERRORS.inc()
             return False
         self._host[digest] = blob
+        self._host_bytes += _blob_nbytes(blob)
         self._indexed += 1
         self.demoted_pages += 1
         tm.KV_TIER_DEMOTED.inc()
         while len(self._host) > self._host_cap:
             d, spill = self._host.popitem(last=False)
+            self._host_bytes -= _blob_nbytes(spill)
             if not self._spill_to_disk(d, spill):
                 self._indexed -= 1  # dropped from the tier entirely
         return True
+
+    def _evict_disk_lru(self) -> None:
+        """Drop the disk tier's LRU entry and its file (count or byte
+        bound exceeded)."""
+        d, meta = self._disk.popitem(last=False)
+        self._disk_bytes -= meta.nbytes
+        self._indexed -= 1
+        try:
+            os.unlink(meta.path)
+        except OSError:
+            pass
 
     def _spill_to_disk(self, digest: bytes, blob) -> bool:
         """Host-ring overflow: write the LRU entry's bytes to one file
@@ -208,16 +250,33 @@ class TieredPageStore:
         if not self._disk_cap or self._dir is None:
             return False
         while len(self._disk) >= self._disk_cap:
-            d, meta = self._disk.popitem(last=False)
-            self._indexed -= 1
-            try:
-                os.unlink(meta.path)
-            except OSError:
-                pass
+            self._evict_disk_lru()
         path = os.path.join(self._dir, digest.hex() + ".kvp")
         quantized = isinstance(blob, PageBlob)
         payload = blob.payload if quantized else np.asarray(blob)
         scale = blob.scale if quantized else None
+        new_bytes = int(payload.nbytes) + (int(scale.nbytes)
+                                           if quantized else 0)
+        if self._disk_bytes_cap:
+            # byte-audited bound (ISSUE 20 bugfix): page count alone
+            # never audited file SIZES — an oversized entry could hold
+            # disk_cap × its own footprint.  Delete LRU files until the
+            # new entry fits; an entry bigger than the whole bound is
+            # dropped (clean miss), never stored over-bound.
+            evicted = 0
+            while (self._disk
+                   and self._disk_bytes + new_bytes
+                   > self._disk_bytes_cap):
+                self._evict_disk_lru()
+                evicted += 1
+            if evicted:
+                tm.MEM_PRESSURE.inc()
+                self._record("mem.pressure", tier="disk",
+                             evicted_files=evicted,
+                             disk_bytes=self._disk_bytes,
+                             bound_bytes=self._disk_bytes_cap)
+            if self._disk_bytes + new_bytes > self._disk_bytes_cap:
+                return False
         try:
             get_fault_injector().maybe_raise(
                 "kv.tier_io_error", OSError,
@@ -237,7 +296,9 @@ class TieredPageStore:
         self._disk[digest] = _DiskMeta(
             path, payload.shape, payload.dtype,
             scale.shape if quantized else None,
-            scale.dtype if quantized else None)
+            scale.dtype if quantized else None,
+            new_bytes)
+        self._disk_bytes += new_bytes
         self.spilled_pages += 1
         return True
 
@@ -284,7 +345,9 @@ class TieredPageStore:
                 fi.maybe_raise("kv.tier_io_error", OSError,
                                "injected tier I/O error (promotion)")
                 if t == "host":
-                    blobs.append(self._host.pop(d))
+                    got_blob = self._host.pop(d)
+                    self._host_bytes -= _blob_nbytes(got_blob)
+                    blobs.append(got_blob)
                     tiers.append("host")
                     self._inflight += 1
                     continue
@@ -311,6 +374,7 @@ class TieredPageStore:
                 self._drop(d)
                 break
             del self._disk[d]
+            self._disk_bytes -= meta.nbytes
             try:
                 os.unlink(meta.path)
             except OSError:
@@ -339,11 +403,14 @@ class TieredPageStore:
         self._drop(digest)
 
     def _drop(self, digest: bytes) -> None:
-        if self._host.pop(digest, None) is not None:
+        blob = self._host.pop(digest, None)
+        if blob is not None:
+            self._host_bytes -= _blob_nbytes(blob)
             self._indexed -= 1
             return
         meta = self._disk.pop(digest, None)
         if meta is not None:
+            self._disk_bytes -= meta.nbytes
             self._indexed -= 1
             try:
                 os.unlink(meta.path)
@@ -353,12 +420,14 @@ class TieredPageStore:
     def clear(self) -> None:
         """Drop every entry (bench cold-start with the store kept)."""
         self._host.clear()
+        self._host_bytes = 0
         for meta in self._disk.values():
             try:
                 os.unlink(meta.path)
             except OSError:
                 pass
         self._disk.clear()
+        self._disk_bytes = 0
         self._indexed = self._inflight
 
     # -- invariants / lifecycle -----------------------------------------------
@@ -379,6 +448,16 @@ class TieredPageStore:
             raise RuntimeError(
                 f"KV tier invariant: disk tier {len(self._disk)} over "
                 f"cap {self._disk_cap}")
+        if (self._disk_bytes_cap
+                and self._disk_bytes > self._disk_bytes_cap):
+            raise RuntimeError(
+                f"KV tier invariant: disk tier {self._disk_bytes}B "
+                f"over byte bound {self._disk_bytes_cap}B")
+        if self._disk_bytes != sum(m.nbytes
+                                   for m in self._disk.values()):
+            raise RuntimeError(
+                "KV tier invariant: disk byte ledger "
+                f"({self._disk_bytes}) != sum of entry sizes")
         for meta in self._disk.values():
             if not os.path.exists(meta.path):
                 raise RuntimeError(
@@ -388,6 +467,8 @@ class TieredPageStore:
     def stats(self) -> dict:
         return {"host_pages": len(self._host),
                 "disk_pages": len(self._disk),
+                "host_bytes": self._host_bytes,
+                "disk_bytes": self._disk_bytes,
                 "inflight_pages": self._inflight,
                 "demoted_pages": self.demoted_pages,
                 "promoted_pages": self.promoted_pages,
@@ -395,8 +476,11 @@ class TieredPageStore:
                 "io_errors": self.io_errors}
 
     def close(self) -> None:
-        """Release the AIO handle and (for an owned temp dir) the disk
-        files; the store is unusable afterwards."""
+        """Release the AIO handle and every disk entry's file; the
+        store is unusable afterwards.  Files are unlinked even in a
+        user-provided directory (ISSUE 20 bugfix): the in-memory index
+        dies with the process, so files left behind were permanent
+        orphans that no later process could ever read back."""
         if self._aio is not None:
             try:
                 self._aio.close()
@@ -404,8 +488,20 @@ class TieredPageStore:
                 pass
             self._aio = None
         self._host.clear()
+        self._host_bytes = 0
+        for meta in self._disk.values():
+            try:
+                os.unlink(meta.path)
+            except OSError:
+                pass
         self._disk.clear()
+        self._disk_bytes = 0
         self._inflight = 0
         self._indexed = 0
         if self._own_dir and self._dir:
             shutil.rmtree(self._dir, ignore_errors=True)
+
+    @staticmethod
+    def _record(event: str, **fields) -> None:
+        from ....telemetry.flight_recorder import get_flight_recorder
+        get_flight_recorder().record(event, **fields)

@@ -50,9 +50,14 @@ class StateManager:
         # device prefix index — the tier is keyed by its chain digests
         self.tiers: Optional[TieredPageStore] = None
         if tier_host_pages > 0 and self.prefix_cache is not None:
-            self.tiers = TieredPageStore(tier_host_pages,
-                                         disk_pages=tier_disk_pages,
-                                         disk_dir=tier_dir or None)
+            self.tiers = TieredPageStore(
+                tier_host_pages,
+                disk_pages=tier_disk_pages,
+                disk_dir=tier_dir or None,
+                # the disk tier's BYTE bound (ISSUE 20): disk_pages ×
+                # the true quantized per-page footprint, so file sizes
+                # are audited, not just entry counts
+                bytes_per_page=kv_config.bytes_per_page)
         #: chain digests whose device pages were imported from a peer
         #: replica (cross-replica page fetch) — attributes their FIRST
         #: local match to the "remote" tier in the workload ledger

@@ -64,6 +64,7 @@ from ...telemetry import get_tracer, trace_span
 from ...telemetry import journey as _journey
 from ...telemetry import metrics as tm
 from ...telemetry.flight_recorder import get_flight_recorder
+from ...telemetry.memory import get_memory_ledger
 from ...telemetry.state import state as _telemetry
 from ...telemetry.timeseries import get_timeseries
 from ...telemetry.watchdog import get_watchdog
@@ -328,6 +329,12 @@ class FastGenScheduler:
         #: so a serving process samples without a background thread
         self._tseries = get_timeseries()
         self._bind_backlog_gauges()
+        # -- memory observatory (ISSUE 20): the scheduler owns the
+        # handoff staging bytes (prefill KV parked in `_handoff_ready`
+        # awaiting a decode-replica fetch) and drives the per-step
+        # ledger sample so gauges track the step cadence, not wall time
+        self._mledger = get_memory_ledger()
+        self._register_staging_accountant()
         # -- speculative decoding (ISSUE 10) --------------------------
         self._spec_cfg = bool(getattr(sv, "speculative", False)
                               and self._role != "prefill")
@@ -388,6 +395,30 @@ class FastGenScheduler:
         tm.FASTGEN_QUEUE_DEPTH.bind(read("_pending"))
         tm.FASTGEN_RUNNING.bind(read("_running"))
         tm.FASTGEN_PREEMPTED.bind(read("_preempted"))
+
+    def _register_staging_accountant(self) -> None:
+        """Account handoff staging bytes (ISSUE 20): KV pages a prefill
+        replica holds parked in ``_handoff_ready`` waiting for a decode
+        replica to fetch them.  Those pages live inside the device KV
+        pool (already counted by ``kv_pages``), but they are *committed*
+        capacity the allocator cannot reclaim — the ledger tracks them
+        as their own subsystem so a stuck handoff shows up as a growing
+        ``ds_mem_staging_bytes`` instead of mystery KV pressure."""
+        kv = self._engine.model.kv_config
+        page, bpp = kv.page_size, kv.bytes_per_page
+
+        def staging_bytes(sched, _page=page, _bpp=bpp):
+            total = 0
+            state = sched._engine.state_manager
+            for uid, req in list(sched._handoff_ready.items()):
+                try:
+                    toks = state.get_sequence(uid).seen_tokens
+                except Exception:
+                    toks = len(req.prompt)
+                total += -(-int(toks) // _page) * _bpp
+            return total
+
+        self._mledger.register_object("staging", self, staging_bytes)
 
     # -- workload trace (ISSUE 9): capture at drain/error points -------------
     def _trace_finish(self, req: Request, outcome: str) -> None:
@@ -1362,6 +1393,10 @@ class FastGenScheduler:
             # inside, so a fast step loop samples at the configured
             # cadence, not per step
             self._tseries.maybe_sample()
+        # memory ledger tick (ISSUE 20): watermark peaks track the
+        # step cadence (the time-series hook above only fires at its
+        # sampling interval — peaks between ticks would be lost)
+        self._mledger.sample()
         return out
 
     def _match_prefix_once(self, req: Request, adm: _Admission) -> None:
@@ -1933,27 +1968,53 @@ class FastGenScheduler:
                 self._pending.insert(0, req)
         self._oom_streak += 1
         tm.KV_ALLOC_FAIL.inc()
+        tm.MEM_PRESSURE.inc()
         get_flight_recorder().record(
             "kv.alloc_fail", streak=self._oom_streak,
             error=str(exc)[:200])
         state = self._engine.state_manager
         alloc = state.kv_cache.allocator
+        # OOM forensics (ISSUE 20): each rung logs the pages it
+        # actually freed so a postmortem shows which lever mattered
+        rungs: List[Dict[str, int]] = []
+        before = alloc.free_pages
         if alloc.parked_pages:
             # rung 1: parked prefix-cache pages are the otherwise-idle
             # pool — evict them all before touching live requests
             state.ensure_free(alloc.free_pages + alloc.parked_pages)
             self._preempted_this_step = True  # pages freed: progress
+            rungs.append({"lever": "reclaim_parked",
+                          "pages_freed": alloc.free_pages - before})
         if self._oom_streak >= 2:
+            before = alloc.free_pages
             self._preempt_largest()
+            rungs.append({"lever": "preempt_largest",
+                          "pages_freed": alloc.free_pages - before})
         if self._oom_streak >= 4:
             victim = self._most_demanding_request()
             if victim is not None:
+                before = alloc.free_pages
                 self._fail_request(
                     victim, "oom",
                     "KV pool exhausted after parked-page eviction and "
                     f"preemption ({self._oom_streak} consecutive "
                     "allocation failures)")
                 self._preempted_this_step = True
+                rungs.append({"lever": "shed_request",
+                              "pages_freed": alloc.free_pages - before})
+        freed = sum(max(r["pages_freed"], 0) for r in rungs)
+        if freed:
+            tm.MEM_DEGRADE_FREED_PAGES.inc(freed)
+        if _telemetry.enabled:
+            # breakdown snapshot into the flight recorder: who owned
+            # the bytes when the allocator starved (the dominant
+            # subsystem names the lever a capacity fix should pull)
+            bd = self._mledger.breakdown()
+            get_flight_recorder().record(
+                "mem.breakdown", trigger="kv.alloc_oom",
+                streak=self._oom_streak, dominant=bd["dominant"],
+                accounted_bytes=bd["accounted_bytes"],
+                subsystems=bd["subsystems"], rungs=rungs)
         self.last_step_scheduled = 0
 
     # -- live engine snapshot / deterministic restore (ISSUE 8) --------------

@@ -50,6 +50,7 @@ from .federation import \
 from .slo import SLOEvaluator, get_slo_evaluator  # noqa: F401
 from .journey import (Journey, JourneyLog,  # noqa: F401
                       get_journey_log)
+from .memory import MemoryLedger, get_memory_ledger  # noqa: F401
 from .server import serve_registry  # noqa: F401
 
 

@@ -70,6 +70,7 @@ EVENT_KINDS = frozenset({
     "fastgen.reopen", "fastgen.restore", "fastgen.snapshot",
     "journey.flush", "journey.fragment",
     "kv.alloc_fail", "kv.demote", "kv.evict", "kv.promote",
+    "mem.breakdown", "mem.pressure",
     "pool.advice_applied", "pool.build", "pool.page_fetch",
     "pool.rebalance",
     "pool.replica_add", "pool.replica_death", "pool.scale_down",
@@ -234,6 +235,13 @@ class FlightRecorder:
             doc = tsr.to_json()
             if doc["samples"]:
                 write("timeseries.json", doc)
+        # memory.json (ISSUE 20): the ledger's full breakdown naming
+        # the dominant subsystem — on/off with accountant registration
+        # (an engine build arms it; telemetry-only processes skip it)
+        from .memory import get_memory_ledger
+        mdoc = get_memory_ledger().to_json()
+        if mdoc is not None:
+            write("memory.json", mdoc)
         return paths
 
     # -- automatic invocation paths ------------------------------------------

@@ -7,7 +7,7 @@ registry against docs/DESIGN.md's metric table in tier-1.
 
 Naming convention: ``ds_<area>_<name>`` with area one of
 {serving, comm, kv, train, fastgen, chaos, fleet, slo, telemetry,
-pool, disagg, journey};
+pool, disagg, journey, mem};
 counters end in ``_total``.
 """
 
@@ -403,6 +403,70 @@ JOURNEY_SEGMENT_MS = registry.histogram(
     "ds_journey_segment_ms",
     "duration of one typed journey segment (queue_wait, placement, "
     "prefill, handoff_*, migrate, decode, ...), observed at flush")
+
+# -- memory observatory (ISSUE 20) --------------------------------------------
+MEM_WEIGHTS_BYTES = registry.gauge(
+    "ds_mem_weights_bytes",
+    "model weight bytes resident in this process (per-shard slice "
+    "footprint under tensor parallelism, not the global array size)")
+MEM_KV_PAGES_BYTES = registry.gauge(
+    "ds_mem_kv_pages_bytes",
+    "device KV page pool bytes at the true quantized bytes_per_page "
+    "footprint (codes + scales)")
+MEM_DRAFT_KV_BYTES = registry.gauge(
+    "ds_mem_draft_kv_bytes",
+    "draft-model KV page pool bytes (0 when model-drafted speculation "
+    "is off)")
+MEM_TIER_HOST_BYTES = registry.gauge(
+    "ds_mem_tier_host_bytes",
+    "KV tier host DRAM ring bytes (evicted page blobs parked in host "
+    "memory)")
+MEM_TIER_DISK_BYTES = registry.gauge(
+    "ds_mem_tier_disk_bytes",
+    "KV tier disk directory bytes (spilled page files, byte-audited "
+    "against the kv_tier_disk_pages bound)")
+MEM_OFFLOAD_BYTES = registry.gauge(
+    "ds_mem_offload_bytes",
+    "offloaded host KV blob bytes held by the state manager")
+MEM_STAGING_BYTES = registry.gauge(
+    "ds_mem_staging_bytes",
+    "snapshot/handoff staging bytes: committed KV held for "
+    "handoff-ready sequences awaiting collection")
+MEM_TELEMETRY_BYTES = registry.gauge(
+    "ds_mem_telemetry_bytes",
+    "approximate footprint of the telemetry rings themselves (span "
+    "buffer, flight events, time-series ring)")
+MEM_ACCOUNTED_BYTES = registry.gauge(
+    "ds_mem_accounted_bytes",
+    "sum of every registered memory-ledger accountant")
+MEM_PEAK_ACCOUNTED_BYTES = registry.gauge(
+    "ds_mem_peak_accounted_bytes",
+    "watermark peak of ds_mem_accounted_bytes since ledger arm/reset")
+MEM_MEASURED_BYTES = registry.gauge(
+    "ds_mem_measured_bytes",
+    "resident bytes from the truth ladder: device memory_stats, live "
+    "jax buffers (CPU-debug), process RSS")
+MEM_UNACCOUNTED_BYTES = registry.gauge(
+    "ds_mem_unaccounted_bytes",
+    "measured bytes minus device-resident accounted bytes — the "
+    "residual that makes accounting drift visible instead of silent")
+MEM_HEADROOM_SEQS = registry.gauge(
+    "ds_mem_headroom_seqs",
+    "admissible additional sequences at the observed per-sequence "
+    "page distribution (free + parked pages over the mined p90 "
+    "pages-per-seq)")
+MEM_PRESSURE = registry.counter(
+    "ds_mem_pressure_total",
+    "memory-pressure events: tier disk byte-bound LRU evictions and "
+    "KV allocation failures entering the degrade ladder")
+MEM_DRIFT_ANOMALY = registry.counter(
+    "ds_mem_drift_anomaly_total",
+    "resident-bytes samples flagged by the watchdog memory-drift "
+    "detector (EWMA growth, storm semantics like step-time anomalies)")
+MEM_DEGRADE_FREED_PAGES = registry.counter(
+    "ds_mem_degrade_freed_pages_total",
+    "KV pages freed by degrade-ladder rungs (reclaim/preempt/shed), "
+    "accounted per lever in the mem.breakdown flight event")
 
 # -- serving SLO histograms (recorded per request at drain time) ------------
 FASTGEN_TTFT_MS = registry.histogram(

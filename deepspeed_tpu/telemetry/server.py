@@ -14,6 +14,9 @@ config's ``telemetry.metrics_port``).  Serves:
   contents — so a pool router can scrape placement hints per replica
 - ``/fleet``    — the federation's merged ``ds_fleet_*`` view over the
   configured replica targets (text; ``?json=1`` for JSON)
+- ``/memory``   — the memory ledger's per-subsystem breakdown, peaks,
+  device truth and residual (ISSUE 20; text table, ``?json=1`` for
+  JSON; 404 until an engine build registers accountants)
 - ``/trace``    — current span ring buffer as Chrome-trace JSON
 - ``/journey``  — ``?uid=<uid>`` returns this process's completed
   journey records and exported fragments for that request (ISSUE 19);
@@ -86,6 +89,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             ctype = "application/json"
         elif path == "/fleet":
             self._do_fleet(params)
+            return
+        elif path == "/memory":
+            self._do_memory(params)
             return
         elif path == "/trace":
             body = json.dumps({
@@ -189,6 +195,49 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _do_memory(self, params) -> None:
+        """The memory ledger's breakdown (ISSUE 20): per-subsystem
+        bytes + peaks, totals, device truth and residual.  404 until a
+        subsystem registers (an engine build arms the ledger) — the
+        /fleet unconfigured convention."""
+        from .memory import get_memory_ledger
+        doc = get_memory_ledger().to_json()
+        if doc is None:
+            self.send_error(
+                404, "memory ledger unarmed: no subsystem accountants "
+                "registered in this process (build an engine first)")
+            return
+        if params.get("json", ["0"])[0] not in ("", "0"):
+            body = json.dumps(doc).encode()
+            ctype = "application/json"
+        else:
+            lines = [f"{'subsystem':<12} {'bytes':>14} {'peak':>14}"]
+            for name, b in sorted(doc["subsystems"].items(),
+                                  key=lambda kv: -kv[1]):
+                lines.append(f"{name:<12} {b:>14} "
+                             f"{doc['peaks'].get(name, 0):>14}")
+            lines.append(f"{'accounted':<12} "
+                         f"{doc['accounted_bytes']:>14} "
+                         f"{doc['peak_accounted_bytes']:>14}")
+            measured = doc["measured_bytes"]
+            lines.append(
+                f"{'measured':<12} "
+                f"{measured if measured is not None else '-':>14} "
+                f"({doc['measured_source']})")
+            un = doc["unaccounted_bytes"]
+            lines.append(f"{'unaccounted':<12} "
+                         f"{un if un is not None else '-':>14}")
+            if doc.get("headroom_seqs") is not None:
+                lines.append(f"{'headroom':<12} "
+                             f"{doc['headroom_seqs']:>14} seqs")
+            body = ("\n".join(lines) + "\n").encode()
+            ctype = "text/plain; charset=utf-8"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, fmt, *args):  # quiet: no per-scrape stderr spam
         pass
 
@@ -227,7 +276,8 @@ def start_http_server(port: int,
     tm.TELEMETRY_PORT.set(bound)
     from ..utils.logging import logger
     logger.info("telemetry: metrics endpoint on %s:%d "
-                "(/metrics /snapshot /fleet /trace /journey /healthz)",
+                "(/metrics /snapshot /fleet /memory /trace /journey "
+                "/healthz)",
                 addr, bound)
     return srv
 

@@ -26,6 +26,11 @@ Objective kinds, all computed from the time-series ring
 - ``balance``  — max/min per-replica rate of a counter across a
   federation (:meth:`~.federation.Federation.replica_rates`) vs
   ``max_ratio`` (the hot-spot / rebalance signal).
+- ``capacity`` — fraction of window samples where a headroom gauge
+  (default ``ds_mem_headroom_seqs``, the memory ledger's admissible-
+  sequences signal) sits below ``min_headroom_seqs`` vs ``budget`` —
+  the page fires while admissions still succeed, BEFORE the OOM
+  degrade ladder starts shedding.
 
 Verdicts are ``ok``/``warn``/``page`` with structured advice records
 (``scale_up`` / ``scale_down`` / ``rebalance``); every status
@@ -45,7 +50,7 @@ from typing import Any, Dict, List, Optional
 
 from . import metrics as tm
 
-KINDS = ("latency", "ratio", "throughput_min", "balance")
+KINDS = ("latency", "ratio", "throughput_min", "balance", "capacity")
 SEVERITY = {"ok": 0, "warn": 1, "page": 2}
 
 DEFAULT_FAST_WINDOW_S = 60.0
@@ -56,7 +61,8 @@ DEFAULT_WARN_BURN = 2.0
 SLOW_FACTOR = 0.5
 
 _DEFAULT_ADVICE = {"latency": "scale_up", "ratio": "scale_up",
-                   "throughput_min": "scale_up", "balance": "rebalance"}
+                   "throughput_min": "scale_up", "balance": "rebalance",
+                   "capacity": "scale_up"}
 
 
 def _normalize(spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -71,7 +77,8 @@ def _normalize(spec: Dict[str, Any]) -> Dict[str, Any]:
     required = {"latency": ("hist", "threshold_ms"),
                 "ratio": ("bad", "total"),
                 "throughput_min": ("counter", "min_per_s"),
-                "balance": ("counter",)}[kind]
+                "balance": ("counter",),
+                "capacity": ("min_headroom_seqs",)}[kind]
     for field in required:
         if field not in o:
             raise ValueError(
@@ -88,6 +95,12 @@ def _normalize(spec: Dict[str, Any]) -> Dict[str, Any]:
         raise ValueError(f"slo objective {o['name']!r}: budget must "
                          "be > 0")
     o.setdefault("max_ratio", 4.0)
+    o.setdefault("metric", "ds_mem_headroom_seqs")
+    if kind == "capacity" and float(o["min_headroom_seqs"]) <= 0:
+        # a zero floor can never be undershot (headroom gauges clamp
+        # at 0) — the objective would be forever-ok, silently
+        raise ValueError(f"slo objective {o['name']!r}: "
+                         "min_headroom_seqs must be > 0")
     if kind == "throughput_min" and float(o["min_per_s"]) <= 0:
         # a zero floor would divide by zero inside evaluate(), where
         # the sampler hook's guard would silently swallow it — refuse
@@ -194,6 +207,13 @@ class SLOEvaluator:
                 return None
             shortfall = max(0.0, 1.0 - rate / float(o["min_per_s"]))
             return shortfall / o["budget"]
+        if kind == "capacity":
+            series = ts.gauge_series(o["metric"], window_s)
+            if not series:
+                return None
+            floor = float(o["min_headroom_seqs"])
+            bad = sum(1 for _, v in series if v < floor)
+            return (bad / len(series)) / o["budget"]
         # balance: federation-fed, windowless (scrape-to-scrape)
         fed = self._federation
         if fed is None:
@@ -221,6 +241,9 @@ class SLOEvaluator:
         if kind == "ratio":
             return (round(fast_burn * o["budget"], 6)
                     if fast_burn is not None else None)
+        if kind == "capacity":
+            series = ts.gauge_series(o["metric"], w_s)
+            return series[-1][1] if series else None
         return None
 
     # -- evaluation ----------------------------------------------------------

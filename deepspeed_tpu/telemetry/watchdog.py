@@ -61,6 +61,22 @@ class _KindState:
         self.last_anomaly_ms = 0.0
 
 
+class _DriftState:
+    """Resident-bytes EWMA state for the memory-drift detector
+    (ISSUE 20) — the step-time machinery with bytes in place of ms."""
+    __slots__ = ("mean_b", "n", "in_storm", "calm", "anomalies",
+                 "last_b", "last_anomaly_b")
+
+    def __init__(self):
+        self.mean_b = 0.0
+        self.n = 0
+        self.in_storm = False
+        self.calm = 0
+        self.anomalies = 0
+        self.last_b = 0.0
+        self.last_anomaly_b = 0.0
+
+
 #: goodput phases; ``idle`` is derived (wall − accounted), never noted
 GOODPUT_PHASES = ("compile", "input_wait", "step", "checkpoint")
 
@@ -108,6 +124,13 @@ class Watchdog:
         self.calm_steps = 8          # normal steps that end a storm
         self.storm_compiles = 3      # on-path compiles within...
         self.storm_window_s = 60.0   # ...this window = a recompile storm
+        # memory-drift detector (ISSUE 20): resident bytes fed from the
+        # ledger's time-series hook; growth past threshold × EWMA (and
+        # past the absolute floor) is a drift anomaly — a leaking codec
+        # path shows here in production mode, not just under DS_KV_DEBUG
+        self.mem_threshold = 1.5
+        self.mem_min_delta_bytes = 32 << 20
+        self._mem = _DriftState()
         self.postmortem_dir = os.environ.get("DS_POSTMORTEM_DIR", "")
         # RLock, not Lock: the DS_POSTMORTEM_ON_EXIT SIGTERM handler
         # runs dump_postmortem -> health() on the main thread, possibly
@@ -212,6 +235,55 @@ class Watchdog:
                 "logging until %d normal steps pass",
                 kind, step, ms, mean, self.threshold, self.calm_steps)
             self._dump_anomaly_trace(kind, step)
+
+    # -- memory-drift detector (ISSUE 20) ------------------------------------
+    # dslint: disabled-path
+    def observe_resident_bytes(self, nbytes: float,
+                               step: int = 0) -> None:
+        """Feed one post-step resident-bytes observation (the memory
+        ledger's time-series hook).  After ``warmup`` samples, resident
+        bytes above ``mem_threshold ×`` the EWMA mean (and at least
+        ``mem_min_delta_bytes`` over it) is a drift anomaly: counter +
+        flight event + warn-once-per-storm.  Anomalous samples do NOT
+        update the EWMA (a leak must not drag the baseline up and mask
+        itself); the storm ends after ``calm_steps`` normal samples."""
+        if not (state.enabled and self.enabled):
+            return
+        with self._lock:
+            w = self._mem
+            w.last_b = nbytes
+            anomalous = (
+                w.n >= self.warmup and w.mean_b > 0.0
+                and nbytes > w.mean_b * self.mem_threshold
+                and nbytes - w.mean_b > self.mem_min_delta_bytes)
+            if not anomalous:
+                w.mean_b += self.alpha * (nbytes - w.mean_b)
+                w.n += 1
+                if w.in_storm:
+                    w.calm += 1
+                    if w.calm >= self.calm_steps:
+                        w.in_storm = False
+                return
+            w.anomalies += 1
+            w.last_anomaly_b = nbytes
+            first_of_storm = not w.in_storm
+            w.in_storm = True
+            w.calm = 0
+            mean = w.mean_b
+        tm.MEM_DRIFT_ANOMALY.inc()
+        self._record_event("watchdog.anomaly", stream="memory",
+                           at_step=step, bytes=int(nbytes),
+                           ewma_bytes=int(mean))
+        if first_of_storm:
+            self._logger().warning(
+                "watchdog: resident memory %.1fMB vs EWMA %.1fMB "
+                "(>%.1fx) — memory-drift storm begins; further "
+                "anomalies count in ds_mem_drift_anomaly_total "
+                "without logging until %d normal samples pass "
+                "(breakdown: /memory endpoint or memory.json "
+                "postmortem)",
+                nbytes / 2**20, mean / 2**20, self.mem_threshold,
+                self.calm_steps)
 
     def _dump_anomaly_trace(self, kind: str, step: int) -> None:
         """Write the span ring around the offending step as a Chrome
@@ -350,9 +422,16 @@ class Watchdog:
                     "last_ms": round(w.last_ms, 3)}
                 for k, w in self._kinds.items()}
             nonfinite_recent = self._nonfinite_recent
+            m = self._mem
+            mem_drift = {"ewma_bytes": int(m.mean_b),
+                         "samples": m.n,
+                         "anomalies": m.anomalies,
+                         "in_storm": m.in_storm,
+                         "last_bytes": int(m.last_b)}
         nonfinite = tm.TRAIN_NONFINITE.value
         status = "ok"
-        if any(w["in_storm"] for w in kinds.values()):
+        if (any(w["in_storm"] for w in kinds.values())
+                or mem_drift["in_storm"]):
             status = "anomaly"
         if nonfinite_recent > 0:
             # recency, not history: the verdict heals after calm_steps
@@ -364,6 +443,7 @@ class Watchdog:
             "telemetry_enabled": state.enabled,
             "watchdog_enabled": self.enabled,
             "step_time": kinds,
+            "memory_drift": mem_drift,
             "nonfinite_total": nonfinite,
             "overflow_skip_total": tm.TRAIN_OVERFLOW_SKIP.value,
             "anomaly_total": tm.TRAIN_ANOMALY.value,
@@ -401,6 +481,7 @@ class Watchdog:
             self._compile_times.clear()
             self._compile_keys.clear()
             self._in_compile_storm = False
+            self._mem = _DriftState()
 
     @staticmethod
     def _record_event(event: str, **fields) -> None:

@@ -64,6 +64,10 @@ CHAOS_TESTS = frozenset([
     # serving.preempt site kills a replica mid-replay; the pool absorbs
     # the death and a scale_up restores capacity with zero lost requests
     "tests/test_replica_pool.py::TestPoolKillAddReplay::test_replayed_kill_add_loses_nothing",
+    # ISSUE 20: the injected kv.alloc_oom walks the degrade ladder and
+    # must leave a mem.breakdown forensics event with per-rung
+    # pages-freed accounting
+    "tests/test_memory_observatory.py::TestOOMForensics::test_injected_oom_leaves_breakdown_with_rungs",
 ])
 
 HEAVY_TESTS = frozenset([

@@ -342,7 +342,18 @@ class TestFlightRecorder:
             pass
         out = str(tmp_path / "pm")
         paths = telemetry.dump_postmortem(out)
-        assert set(paths) == BUNDLE
+        # conditional artifacts ride iff their subsystem has state in
+        # THIS process (engine builds arm the memory ledger; completed
+        # requests fill the journey log) — suite ordering must not
+        # decide this test
+        from deepspeed_tpu.telemetry.journey import get_journey_log
+        from deepspeed_tpu.telemetry.memory import get_memory_ledger
+        expect = set(BUNDLE)
+        if get_memory_ledger().armed:
+            expect.add("memory.json")
+        if get_journey_log().tail_json() is not None:
+            expect.add("journeys.json")
+        assert set(paths) == expect
         docs = {name: json.load(open(p)) for name, p in paths.items()}
         # registry snapshot: the full minted namespace, flat
         assert "ds_serving_steps_total" in docs["registry.json"]

@@ -549,10 +549,23 @@ class TestPostmortemArtifact:
         # journeys.json follows the same skip-when-empty rule as the
         # ledger tail (ISSUE 19) — a journey-free process ships neither
         journey.get_journey_log().clear()
+        # memory.json follows the same rule keyed on accountant
+        # registration (ISSUE 20): simulate a process whose ledger
+        # never armed, restoring the suite's accountants after
+        from deepspeed_tpu.telemetry.memory import get_memory_ledger
+        led = get_memory_ledger()
+        saved_acct, saved_dev = dict(led._accountants), dict(led._device)
+        led.reset()
         monkeypatch.setattr(telemetry.state, "enabled", True)
-        paths = telemetry.dump_postmortem(str(tmp_path / "pm5"))
+        try:
+            paths = telemetry.dump_postmortem(str(tmp_path / "pm5"))
+        finally:
+            with led._lock:
+                led._accountants.update(saved_acct)
+                led._device.update(saved_dev)
         assert "workload.jsonl" not in paths
         assert "journeys.json" not in paths
+        assert "memory.json" not in paths
         assert len(paths) == 5
 
 

@@ -19,6 +19,9 @@ From a JSONL ledger captured by ``telemetry/workload_trace.py``:
   flattened ``journey_<bucket>_ms`` TTFT-decomposition scalars, plus
   dominant-segment attribution for the slowest decile (legacy traces
   note-and-degrade);
+- a **memory report** (ISSUE 20): the pages-per-sequence distribution
+  and the hot/cold prefix-page split ``tools/plan_capacity.py`` sizes
+  device pools and tier rings from (same mining implementation);
 - a **recommended bucket lattice**: quantile-fitted Q/P boundaries
   (bucket tops placed on the observed length distribution instead of
   fixed powers, bounded per-bucket overshoot) plus a recommended
@@ -48,6 +51,10 @@ try:
     from . import replay_trace
 except ImportError:                      # run as a script: tools/ on path
     import replay_trace
+try:
+    from . import plan_capacity as _plan_capacity
+except ImportError:
+    import plan_capacity as _plan_capacity
 
 
 # the quantile-fitted bucket boundaries now live IN the package
@@ -296,6 +303,26 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
             dominant = {"bucket": seg,
                         "share": round(by_b[seg] / total, 4),
                         "slow_requests": len(slow)}
+    # -- memory mining (ISSUE 20): the same per-sequence page facts
+    # tools/plan_capacity.py plans capacity from, surfaced in the one
+    # mining report — how many whole KV pages a sequence of this
+    # workload charges, and how its prefix pages split hot (reused —
+    # host-ring material) vs cold (once-seen — disk is fine).  Offline
+    # by construction: pool-specific capacity and the live-ledger
+    # cross-check are plan_capacity's --kv-pages / --validate legs.
+    mined = _plan_capacity.mine_memory(requests, page,
+                                       concurrency=concurrency)
+    mem_plan = _plan_capacity.plan(mined, kv_pages=0)
+    memory = {
+        "pages_per_seq": mined["pages_per_seq"],
+        "total_pages": mined["total_pages"],
+        "predicted_seqs_per_1k_pages": mem_plan["seqs_per_1k_pages"],
+        "tier_split": mem_plan["tier_split"],
+        "note": (mined["note"] or
+                 "pool-specific capacity + live-ledger validation: "
+                 "tools/plan_capacity.py --kv-pages N --validate"),
+    }
+
     journeys = {
         "requests_with_journeys": len(jreqs),
         "per_bucket_ms": per_bucket if jreqs else None,
@@ -340,6 +367,7 @@ def analyze(trace: Dict[str, Any], max_concurrency: int = 0,
         "speculation": speculation,
         "tiers": tiers,
         "journeys": journeys,
+        "memory": memory,
         "recommended_lattice": {
             "page_size": page,
             "s_buckets": s_buckets,

@@ -130,6 +130,10 @@ python tools/replay_trace.py --trace tools/traces/sample_200.jsonl \
 echo "== cold-start smoke (persistent compile cache + auto lattice) =="
 python tools/coldstart_smoke.py --check --limit 16 > /dev/null
 
+echo "== memory observatory smoke (ledger validate + OOM forensics) =="
+python tools/plan_capacity.py --trace tools/traces/sample_200.jsonl \
+    --limit 20 --validate --oom-smoke --check > /dev/null
+
 # (the former standalone metric-lint leg is leg 0's metric-catalog
 # rule now; tools/check_metrics.py remains as a local/CI-transition
 # shim over the same implementation)

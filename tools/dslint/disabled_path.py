@@ -38,6 +38,7 @@ REQUIRED_MODULES: Tuple[str, ...] = (
     "deepspeed_tpu/telemetry/timeseries.py",
     "deepspeed_tpu/telemetry/workload_trace.py",
     "deepspeed_tpu/telemetry/watchdog.py",
+    "deepspeed_tpu/telemetry/memory.py",
     "deepspeed_tpu/runtime/fault_injection.py",
 )
 

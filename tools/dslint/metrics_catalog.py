@@ -31,7 +31,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 AREAS = ("serving", "comm", "kv", "train", "fastgen", "chaos",
-         "fleet", "slo", "telemetry", "pool", "disagg", "journey")
+         "fleet", "slo", "telemetry", "pool", "disagg", "journey",
+         "mem")
 NAME_RE = re.compile(
     r"^ds_(%s)_[a-z][a-z0-9_]*$" % "|".join(AREAS))
 

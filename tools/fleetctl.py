@@ -21,6 +21,11 @@ Usage::
                                            # /journey?uid= records and
                                            # stitch one cross-process
                                            # segment chain (ISSUE 19)
+    python tools/fleetctl.py --targets ... mem
+                                           # per-replica ds_mem_*
+                                           # subsystem table, fleet
+                                           # totals, headroom min/sum
+                                           # (ISSUE 20)
     python tools/fleetctl.py --smoke       # CI: two debug replicas,
                                            # merged counters == sum
     python tools/fleetctl.py --kill-demo   # bench: two replicas, one
@@ -612,6 +617,74 @@ def _digests_text(targets: List[Tuple[str, str]], top_k: int = 8) -> str:
     return "\n".join(lines)
 
 
+#: fleet memory table columns (ISSUE 20): subsystem -> gauge name,
+#: the ledger's own publication order
+_MEM_COLUMNS = (
+    ("weights", "ds_mem_weights_bytes"),
+    ("kv_pages", "ds_mem_kv_pages_bytes"),
+    ("draft_kv", "ds_mem_draft_kv_bytes"),
+    ("tier_host", "ds_mem_tier_host_bytes"),
+    ("tier_disk", "ds_mem_tier_disk_bytes"),
+    ("offload", "ds_mem_offload_bytes"),
+    ("staging", "ds_mem_staging_bytes"),
+    ("telemetry", "ds_mem_telemetry_bytes"),
+)
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _mem_text(view: Dict[str, Any]) -> str:
+    """Fleet memory rollup (ISSUE 20): one row per replica over the
+    ``ds_mem_*`` subsystem gauges, fleet totals from the federation's
+    sum rollup, and the capacity signal — fleet headroom is the SUM of
+    per-replica ``ds_mem_headroom_seqs`` (what the fleet can still
+    admit) while the MIN names the replica to stop routing to."""
+    gauges = view.get("gauges", {})
+    labels = sorted(view.get("replicas", {}))
+    cols = [(s, gauges.get(g, {}).get("per_replica", {}))
+            for s, g in _MEM_COLUMNS]
+    out = ["replica   " + "".join(f"{s:>11}" for s, _ in cols)
+           + f"{'unacct':>11}{'headroom':>10}"]
+    unacct = gauges.get("ds_mem_unaccounted_bytes",
+                        {}).get("per_replica", {})
+    head = gauges.get("ds_mem_headroom_seqs", {})
+    head_pr = head.get("per_replica", {})
+    for label in labels:
+        row = f"{label:<10}"
+        for _, pr in cols:
+            row += f"{_fmt_bytes(pr.get(label)):>11}"
+        row += f"{_fmt_bytes(unacct.get(label)):>11}"
+        h = head_pr.get(label)
+        row += f"{(int(h) if h is not None else '-'):>10}"
+        out.append(row)
+    total = f"{'fleet':<10}"
+    for s, g in _MEM_COLUMNS:
+        total += f"{_fmt_bytes(gauges.get(g, {}).get('sum')):>11}"
+    total += f"{_fmt_bytes(gauges.get('ds_mem_unaccounted_bytes', {}).get('sum')):>11}"
+    hs = head.get("sum")
+    total += f"{(int(hs) if hs is not None else '-'):>10}"
+    out.append(total)
+    if head_pr:
+        hmin = min((v, k) for k, v in head_pr.items())
+        out.append(f"headroom: fleet={int(hs or 0)} seqs admissible, "
+                   f"min={int(hmin[0])} on {hmin[1]}")
+    else:
+        out.append("headroom: no ds_mem_headroom_seqs published — "
+                   "replicas predate the memory observatory or "
+                   "telemetry is off")
+    return "\n".join(out)
+
+
 # -- CLI ---------------------------------------------------------------------
 def _status_text(view: Dict[str, Any]) -> str:
     lines = [f"fleet: {view['live']} live, {view['stale']} stale"]
@@ -632,7 +705,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("command", nargs="?", default="status",
                     choices=["status", "json", "metrics", "digests",
-                             "journey"])
+                             "journey", "mem"])
     ap.add_argument("uid", nargs="?", type=int,
                     help="journey command: the request uid to stitch "
                     "across the fleet")
@@ -714,6 +787,8 @@ def main(argv=None) -> int:
             print(json.dumps(fed.snapshot_json(), indent=1))
         elif args.command == "metrics":
             print(fed.prometheus_text(), end="")
+        elif args.command == "mem":
+            print(_mem_text(fed.scrape()))
         else:
             print(_status_text(fed.scrape()))
         if not args.watch:
